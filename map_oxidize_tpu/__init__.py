@@ -1,0 +1,41 @@
+"""map_oxidize_tpu — a TPU-native MapReduce framework.
+
+A from-scratch JAX/XLA re-design of the capabilities of
+``AnarchistHoneybun/map-oxidize`` (crate ``meduce``, a single-file Rust/tokio
+word-count MapReduce — see ``/root/reference/src/main.rs``).  Nothing here is a
+translation: the reference's text-file spill + global-mutex reduce
+(main.rs:103-150) becomes device-resident ``(hash(key), value)`` arrays reduced
+with ``jax.lax.sort`` + segment combines, and its in-process task pools
+(main.rs:53-92, 111-150) become a host-side map executor feeding a sharded
+device engine whose cross-shard shuffle rides XLA ``all_to_all`` / ``psum``
+collectives over the ICI mesh.
+
+Layer map (mirrors SURVEY.md §1, redrawn TPU-first):
+
+* ``runtime.driver``   — phase orchestration (reference L5, main.rs:8-34)
+* ``runtime.executor`` — host map worker pool w/ retries (L4, main.rs:53-92)
+* ``runtime.engine``   — streaming device reduce engine (L4, main.rs:111-150)
+* ``api``              — Mapper/Reducer trait boundary (L3; the reference
+  hardcodes these, main.rs:94-101 + 131-134)
+* ``ops``              — device kernels: hashing, sort+segment reduce, top-k
+* ``parallel``         — mesh, shard_map shuffle, collectives (reference: none)
+* ``io``               — splitter / spill / writer (L2, main.rs:36-51, 103-109,
+  152-182)
+* ``native``           — C++ tokenize/hash hot loop (the reference's "native"
+  tier is the whole Rust binary; ours is the one loop that deserves it)
+"""
+
+__version__ = "0.1.0"
+
+from map_oxidize_tpu.api import Mapper, Reducer, SumReducer, MinReducer, MaxReducer
+from map_oxidize_tpu.config import JobConfig
+
+__all__ = [
+    "Mapper",
+    "Reducer",
+    "SumReducer",
+    "MinReducer",
+    "MaxReducer",
+    "JobConfig",
+    "__version__",
+]

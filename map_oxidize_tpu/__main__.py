@@ -1,0 +1,3 @@
+from map_oxidize_tpu.cli import main
+
+raise SystemExit(main())

@@ -1,0 +1,102 @@
+"""The user-facing Mapper / Reducer trait boundary.
+
+The reference hardcodes its workload: the mapper is ``count_words``
+(``/root/reference/src/main.rs:94-101``) and the reducer is the ``*entry +=
+count`` merge loop (main.rs:131-134), with no abstraction between workload and
+engine.  This module is the boundary the north star names: workloads plug in a
+``Mapper`` (host-side, bytes -> hashed key/value arrays) and a ``Reducer``
+(an associative-commutative monoid the device engine folds with).
+
+Design for TPU: the mapper's contract is *already tensorized* — it emits
+NumPy arrays of (hash-hi, hash-lo, value) plus a host-side hash->bytes
+dictionary — so the engine never sees strings and every downstream op is a
+static-shape device kernel.  Reducers are named monoids, not callbacks:
+the device engine folds with ``jax.ops.segment_{sum,min,max}`` and the
+cross-shard merge with the same monoid over XLA collectives, so the combine
+must be associative+commutative by construction.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from map_oxidize_tpu.ops.hashing import HashDictionary
+
+
+@dataclass
+class MapOutput:
+    """One mapped chunk, ready for the device.
+
+    ``hi``/``lo``: uint32 key-hash planes, ``values``: ``[n]`` or ``[n, d]``
+    array, ``dictionary``: hash -> token bytes for readback (may be empty for
+    integer-keyed workloads such as k-means).
+    """
+
+    hi: np.ndarray
+    lo: np.ndarray
+    values: np.ndarray
+    dictionary: HashDictionary = field(default_factory=HashDictionary)
+    #: number of raw input records the mapper consumed (tokens, points, ...);
+    #: powers the Σvalues == Σinputs conservation checks and throughput metrics.
+    records_in: int = 0
+
+    def __len__(self) -> int:
+        return int(self.hi.shape[0])
+
+
+class Mapper(abc.ABC):
+    """Host-side map: chunk bytes -> hashed key/value arrays.
+
+    Equivalent of the reference's ``count_words`` (main.rs:94-101), but
+    pre-aggregation inside the chunk is the mapper's choice — emitting one row
+    per *distinct* key per chunk (a combiner, which the reference effectively
+    does by using a HashMap) shrinks host->HBM traffic by the chunk's
+    duplication factor.
+    """
+
+    #: shape of one value row ((),) scalar by default; k-means uses (d+1,)
+    value_shape: tuple = ()
+    value_dtype = np.int32
+
+    @abc.abstractmethod
+    def map_chunk(self, chunk: bytes) -> MapOutput:
+        raise NotImplementedError
+
+
+class Reducer:
+    """A named associative-commutative combine monoid.
+
+    The reference's only reducer is integer ``+=`` (main.rs:132-134).  Here the
+    monoid name selects the device segment-combine and the identity element
+    used for padding rows; anything associative+commutative fits the engine
+    (the fold order over batches and shards is not the arrival order).
+    """
+
+    name = "sum"
+
+    def __init__(self, combine: str = "sum"):
+        if combine not in ("sum", "min", "max"):
+            raise ValueError(f"unsupported combine {combine!r}")
+        self.combine = combine
+
+
+class SumReducer(Reducer):
+    def __init__(self):
+        super().__init__("sum")
+
+
+class MinReducer(Reducer):
+    name = "min"
+
+    def __init__(self):
+        super().__init__("min")
+
+
+class MaxReducer(Reducer):
+    name = "max"
+
+    def __init__(self):
+        super().__init__("max")

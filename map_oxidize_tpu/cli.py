@@ -1,0 +1,114 @@
+"""Command-line driver.
+
+The reference has no CLI at all — path and worker counts are hardcoded locals
+(``/root/reference/src/main.rs:10-13``) and the binary must be run in a
+directory containing ``shakes.txt``.  Usage here:
+
+    python -m map_oxidize_tpu wordcount shakes.txt --top-k 10
+    python -m map_oxidize_tpu bigram corpus.txt --backend tpu
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+from map_oxidize_tpu.config import JobConfig
+from map_oxidize_tpu.utils.logging import configure, get_logger
+
+_log = get_logger(__name__)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="map_oxidize_tpu",
+        description="TPU-native MapReduce (capabilities of map-oxidize, rebuilt for JAX/XLA)",
+    )
+    p.add_argument("workload", choices=["wordcount", "bigram"],
+                   help="built-in workload to run")
+    p.add_argument("input", help="input corpus path (reference: shakes.txt)")
+    p.add_argument("--output", default="final_result.txt",
+                   help="final result path (reference: final_result.txt)")
+    p.add_argument("--top-k", type=int, default=10,
+                   help="top-k words to report (reference: 10)")
+    p.add_argument("--map-workers", type=int, default=8,
+                   help="host map threads (reference: 8)")
+    p.add_argument("--num-chunks", type=int, default=0,
+                   help="fixed chunk count with round-robin line chunking "
+                        "(reference compat mode); 0 = streaming byte ranges")
+    p.add_argument("--chunk-mb", type=int, default=32, help="streamed chunk size")
+    p.add_argument("--batch-size", type=int, default=1 << 20,
+                   help="device feed batch rows")
+    p.add_argument("--key-capacity", type=int, default=1 << 22,
+                   help="max distinct keys on device")
+    p.add_argument("--backend", choices=["auto", "cpu", "tpu"], default="auto")
+    p.add_argument("--num-shards", type=int, default=0,
+                   help="device mesh shards (0 = all local devices, 1 = single)")
+    p.add_argument("--tokenizer", choices=["ascii", "unicode"], default="ascii")
+    p.add_argument("--no-native", action="store_true",
+                   help="disable the C++ tokenizer hot loop")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="directory for resumable map-output checkpoints")
+    p.add_argument("--keep-intermediates", action="store_true")
+    p.add_argument("-v", "--verbose", action="store_true")
+    p.add_argument("-q", "--quiet", action="store_true")
+    return p
+
+
+def config_from_args(args: argparse.Namespace) -> JobConfig:
+    return JobConfig(
+        input_path=args.input,
+        output_path=args.output,
+        top_k=args.top_k,
+        num_map_workers=args.map_workers,
+        num_chunks=args.num_chunks,
+        chunk_bytes=args.chunk_mb * 1024 * 1024,
+        batch_size=args.batch_size,
+        key_capacity=args.key_capacity,
+        backend=args.backend,
+        num_shards=args.num_shards,
+        tokenizer=args.tokenizer,
+        use_native=not args.no_native,
+        checkpoint_dir=args.checkpoint_dir,
+        keep_intermediates=args.keep_intermediates,
+    ).validate()
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    configure(logging.DEBUG if args.verbose
+              else logging.WARNING if args.quiet else logging.INFO)
+    try:
+        config = config_from_args(args)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if not os.path.isfile(config.input_path):
+        print(f"error: cannot open input {config.input_path!r}", file=sys.stderr)
+        return 2
+    for flag, val in (("--checkpoint-dir", config.checkpoint_dir),
+                      ("--keep-intermediates", config.keep_intermediates),
+                      ("--num-shards", config.num_shards)):
+        if val:
+            _log.warning("%s is not wired into the runtime yet; ignoring", flag)
+
+    from map_oxidize_tpu.runtime.driver import run_wordcount_job
+
+    if args.workload == "wordcount":
+        from map_oxidize_tpu.workloads.wordcount import make_wordcount
+
+        mapper, reducer = make_wordcount(config.tokenizer, config.use_native)
+    else:
+        from map_oxidize_tpu.workloads.bigram import make_bigram
+
+        mapper, reducer = make_bigram(config.tokenizer)
+
+    result = run_wordcount_job(config, mapper, reducer)
+    print(result.top_report(config.top_k))  # reference stdout, main.rs:188-191
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,67 @@
+"""Job configuration.
+
+Replaces the reference's four hardcoded locals
+(``/root/reference/src/main.rs:10-13``: ``file_path``, ``num_map_workers=8``,
+``num_reduce_workers=4``, ``num_chunks=8``) and the call-site-hardcoded
+``n=10`` top-k (main.rs:28) with a real config object, fed by the CLI
+(``map_oxidize_tpu.cli``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from pathlib import Path
+
+
+@dataclass
+class JobConfig:
+    #: input corpus path (reference: "shakes.txt", main.rs:10)
+    input_path: str = "shakes.txt"
+    #: host map worker threads (reference: 8 tokio tasks, main.rs:11)
+    num_map_workers: int = 8
+    #: input chunks; 0 = derive from file size / chunk_bytes (reference: 8
+    #: round-robin line chunks, main.rs:13 — ours are byte-range shards)
+    num_chunks: int = 0
+    #: target bytes per streamed chunk (whole corpus is never host-resident,
+    #: unlike main.rs:36-51)
+    chunk_bytes: int = 32 * 1024 * 1024
+    #: rows per device feed batch (mapped pairs are padded to this)
+    batch_size: int = 1 << 20
+    #: device accumulator capacity — upper bound on distinct keys per shard
+    key_capacity: int = 1 << 22
+    #: top-k to report (reference: n=10 at main.rs:28)
+    top_k: int = 10
+    #: 'tpu' | 'cpu' | 'auto' — auto uses whatever jax.devices() offers
+    backend: str = "auto"
+    #: number of mesh shards for the device engine; 0 = all local devices
+    num_shards: int = 0
+    #: tokenizer mode: 'ascii' (C++-accelerated byte path) or 'unicode'
+    #: (exact Rust split_whitespace/to_lowercase semantics, main.rs:96-97)
+    tokenizer: str = "ascii"
+    #: output file (reference: "final_result.txt", main.rs:174)
+    output_path: str = "final_result.txt"
+    #: directory for spill/checkpoint artifacts; None disables checkpointing
+    checkpoint_dir: str | None = None
+    #: keep intermediate artifacts instead of deleting (reference always
+    #: cleans up, main.rs:194-202)
+    keep_intermediates: bool = False
+    #: per-chunk map retry budget (reference: abort on first error,
+    #: main.rs:88 `handle.await??`)
+    max_retries: int = 2
+    #: use the C++ native tokenizer when available
+    use_native: bool = True
+    #: emit per-phase timing/throughput metrics
+    metrics: bool = True
+
+    def validate(self) -> "JobConfig":
+        if self.tokenizer not in ("ascii", "unicode"):
+            raise ValueError(f"tokenizer must be ascii|unicode, got {self.tokenizer!r}")
+        if self.backend not in ("auto", "cpu", "tpu"):
+            raise ValueError(f"backend must be auto|cpu|tpu, got {self.backend!r}")
+        if self.batch_size <= 0 or self.key_capacity <= 0:
+            raise ValueError("batch_size and key_capacity must be positive")
+        if self.num_chunks <= 0 and self.chunk_bytes <= 0:
+            raise ValueError("chunk_bytes must be positive (or set num_chunks)")
+        if self.top_k <= 0 or self.num_map_workers <= 0:
+            raise ValueError("top_k and num_map_workers must be positive")
+        return self

@@ -1,0 +1,67 @@
+"""Input splitting.
+
+The reference reads the *whole* corpus into RAM and deals lines round-robin
+into ``num_chunks`` strings (``/root/reference/src/main.rs:36-51``) — O(corpus)
+host residency and a single-threaded pre-pass.  Here the default is a
+**streaming byte-range splitter**: chunks are contiguous byte ranges extended
+to the next newline boundary, yielded lazily, so a 10GB corpus never sits in
+host memory and chunk boundaries never split a line (or a multi-byte UTF-8
+sequence, since '\\n' is ASCII).
+
+A round-robin compat splitter is kept for golden-parity tests against the
+reference's exact chunking; both produce identical global multisets of lines,
+which is all the MapReduce semantics depend on.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator
+
+
+def iter_chunks(path: str, chunk_bytes: int) -> Iterator[bytes]:
+    """Yield newline-aligned byte-range chunks of ~``chunk_bytes`` each."""
+    with open(path, "rb") as f:
+        carry = b""
+        while True:
+            block = f.read(chunk_bytes)
+            if not block:
+                if carry:
+                    yield carry
+                return
+            block = carry + block
+            # extend to next newline so no line straddles chunks
+            nl = block.rfind(b"\n")
+            if nl == -1:
+                carry = block
+                continue
+            yield block[: nl + 1]
+            carry = block[nl + 1 :]
+
+
+def plan_chunks(path: str, chunk_bytes: int, num_chunks: int = 0) -> tuple[int, int]:
+    """Return (num_chunks_estimate, chunk_bytes).  If ``num_chunks`` is given,
+    derive chunk_bytes from the file size instead (reference semantics:
+    a fixed chunk count, main.rs:13)."""
+    size = os.path.getsize(path)
+    if num_chunks > 0:
+        cb = max(1, -(-size // num_chunks))  # ceil div
+        return num_chunks, cb
+    return max(1, -(-size // chunk_bytes)), chunk_bytes
+
+
+def split_round_robin(path: str, num_chunks: int) -> list[bytes]:
+    """Reference-exact chunking: line ``i`` goes to chunk ``i % num_chunks``
+    with '\\n' re-appended (main.rs:44-48).  Whole file resident — only for
+    parity tests and tiny inputs."""
+    chunks = [bytearray() for _ in range(num_chunks)]
+    with open(path, "rb") as f:
+        data = f.read()
+    lines = data.split(b"\n")
+    if lines and lines[-1] == b"":
+        lines.pop()  # trailing newline does not produce an empty final line
+    i = 0
+    for line in lines:
+        chunks[i] += line + b"\n"
+        i = (i + 1) % num_chunks
+    return [bytes(c) for c in chunks]

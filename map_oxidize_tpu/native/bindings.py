@@ -1,0 +1,27 @@
+"""ctypes loader for the C++ map hot loop.  Falls back to None — callers then
+use the pure-Python path, which must stay semantics-identical."""
+
+from __future__ import annotations
+
+from map_oxidize_tpu.utils.logging import get_logger
+
+_log = get_logger(__name__)
+_cached = None
+_tried = False
+
+
+def load_or_none():
+    """Return the native module wrapper, building it on first use, or None if
+    the toolchain/build is unavailable."""
+    global _cached, _tried
+    if _tried:
+        return _cached
+    _tried = True
+    try:
+        from map_oxidize_tpu.native.build import load_native
+
+        _cached = load_native()
+    except Exception as e:  # missing g++, build failure — fall back silently
+        _log.info("native tokenizer unavailable (%s); using Python map path", e)
+        _cached = None
+    return _cached

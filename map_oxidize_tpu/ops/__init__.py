@@ -1,0 +1,33 @@
+"""Device-side kernels: key hashing, sort + segment reduce, top-k."""
+
+from map_oxidize_tpu.ops.hashing import (
+    SENTINEL,
+    fnv1a64,
+    fnv1a64_bytes,
+    hash_tokens,
+    split_u64,
+    join_u64,
+)
+from map_oxidize_tpu.ops.segment_reduce import (
+    segment_reduce_sorted,
+    reduce_pairs,
+    merge_into_accumulator,
+    make_accumulator,
+    COMBINES,
+)
+from map_oxidize_tpu.ops.topk import top_k_pairs
+
+__all__ = [
+    "SENTINEL",
+    "fnv1a64",
+    "fnv1a64_bytes",
+    "hash_tokens",
+    "split_u64",
+    "join_u64",
+    "segment_reduce_sorted",
+    "reduce_pairs",
+    "merge_into_accumulator",
+    "make_accumulator",
+    "COMBINES",
+    "top_k_pairs",
+]

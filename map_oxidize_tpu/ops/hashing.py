@@ -1,0 +1,103 @@
+"""64-bit key hashing for string keys on a tensor machine.
+
+The reference keeps keys as Rust ``String``s in ``HashMap``s
+(``/root/reference/src/main.rs:94-101``); a TPU has no strings, so every key
+is committed to a 64-bit FNV-1a hash.  The hash is carried on device as a pair
+of ``uint32`` planes ``(hi, lo)`` — TPUs prefer 32-bit lanes and
+``jax.lax.sort`` takes multiple key operands (``num_keys=2``), so we never need
+``jax_enable_x64``.  Host-side dictionaries (hash -> original token bytes) are
+kept per map shard and unioned at readback so exact strings — and therefore
+top-k parity with the reference's output (main.rs:184-192) — are recoverable.
+
+A 64-bit space makes collisions vanishingly unlikely for realistic key
+cardinalities (~1e-7 for 100M distinct keys); the host dictionary union
+nevertheless *detects* any collision (same hash, different bytes) and raises.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+FNV_OFFSET = 0xCBF29CE484222325
+FNV_PRIME = 0x100000001B3
+_MASK64 = 0xFFFFFFFFFFFFFFFF
+
+#: Key value reserved for padding rows on device.  Rows whose (hi, lo) both
+#: equal SENTINEL sort to the end and are excluded from unique-key counts.
+SENTINEL = 0xFFFFFFFF
+SENTINEL64 = 0xFFFFFFFFFFFFFFFF
+
+
+def fnv1a64_bytes(data: bytes) -> int:
+    """FNV-1a 64-bit of ``data``.  Any native map path must mirror this
+    exactly so all map paths emit identical keys."""
+    h = FNV_OFFSET
+    for b in data:
+        h = ((h ^ b) * FNV_PRIME) & _MASK64
+    return h
+
+
+def fnv1a64(token: "bytes | str") -> int:
+    if isinstance(token, str):
+        token = token.encode("utf-8")
+    return fnv1a64_bytes(token)
+
+
+def hash_tokens(tokens) -> np.ndarray:
+    """Hash an iterable of tokens (bytes or str) to a uint64 array."""
+    return np.fromiter(
+        (fnv1a64(t) for t in tokens), dtype=np.uint64, count=len(tokens)
+    )
+
+
+def split_u64(h: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """uint64 -> (hi, lo) uint32 planes, the on-device key representation."""
+    h = np.asarray(h, dtype=np.uint64)
+    hi = (h >> np.uint64(32)).astype(np.uint32)
+    lo = (h & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    return hi, lo
+
+
+def join_u64(hi: np.ndarray, lo: np.ndarray) -> np.ndarray:
+    """(hi, lo) uint32 planes -> uint64, for host-side dictionary lookup."""
+    return (np.asarray(hi, np.uint64) << np.uint64(32)) | np.asarray(lo, np.uint64)
+
+
+class HashDictionary:
+    """Host-side hash -> token-bytes mapping with collision detection.
+
+    Replaces the reference's reliance on real strings flowing through every
+    phase (main.rs:105-107 writes ``"{word} {count}"`` text; main.rs:158-165
+    re-parses it).  Here strings stay on the host; only hashes travel.
+    """
+
+    __slots__ = ("_d",)
+
+    def __init__(self) -> None:
+        self._d: dict[int, bytes] = {}
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+    def add(self, h: int, token: bytes) -> None:
+        prev = self._d.get(h)
+        if prev is None:
+            self._d[h] = token
+        elif prev != token:
+            raise ValueError(
+                f"64-bit hash collision: {prev!r} and {token!r} both hash to {h:#x}"
+            )
+
+    def update(self, other: "HashDictionary | dict[int, bytes]") -> None:
+        items = other._d.items() if isinstance(other, HashDictionary) else other.items()
+        for h, tok in items:
+            self.add(h, tok)
+
+    def lookup(self, h: int) -> bytes:
+        return self._d[h]
+
+    def get(self, h: int, default: bytes | None = None) -> bytes | None:
+        return self._d.get(h, default)
+
+    def items(self):
+        return self._d.items()

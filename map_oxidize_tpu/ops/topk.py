@@ -1,0 +1,31 @@
+"""Device top-k over reduced (key, count) pairs.
+
+Replaces the reference's host-side full sort of every entry
+(``/root/reference/src/main.rs:184-192``: collect + ``sort_by_key(Reverse)``
++ take 10) with ``jax.lax.top_k`` on device — O(n log k)-ish on the VPU and
+only k rows ever cross HBM->host.  The reference's tie order is
+nondeterministic (HashMap iteration); ours is deterministic: ``lax.top_k``
+prefers the lowest index on ties and our rows are key-sorted, so ties break by
+ascending 64-bit key hash.  Exact-string output is recovered on the host via
+the HashDictionary.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def top_k_pairs(hi, lo, counts, k: int):
+    """Top-``k`` rows by ``counts`` (descending).  Returns
+    ``(hi_k, lo_k, counts_k)``.  Padding rows carry identity counts (0 for
+    sum) so they lose to any real row with a positive count."""
+    if counts.ndim != 1:
+        raise ValueError("top_k_pairs expects scalar per-key counts")
+    top_vals, top_idx = lax.top_k(counts, k)
+    return jnp.take(hi, top_idx), jnp.take(lo, top_idx), top_vals
+
+
+#: cached-compile variant for repeated host-driven calls
+top_k_pairs_jit = jax.jit(top_k_pairs, static_argnames="k")

@@ -1,0 +1,112 @@
+"""Job driver: phase orchestration.
+
+The reference's ``main()`` runs six barriered phases — split, map, reduce,
+write, report, cleanup (``/root/reference/src/main.rs:8-34``).  This driver
+keeps the same observable phase contract but fuses map+reduce into one
+streaming phase (host map workers feed the device engine concurrently; there
+is no materialization barrier between them) and adds what the reference lacks:
+config, metrics, retries, checkpointing hooks, and deterministic output.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from map_oxidize_tpu.api import Mapper, Reducer
+from map_oxidize_tpu.config import JobConfig
+from map_oxidize_tpu.io.splitter import iter_chunks, plan_chunks, split_round_robin
+from map_oxidize_tpu.io.writer import format_top_words, write_final_result
+from map_oxidize_tpu.ops.hashing import HashDictionary, join_u64
+from map_oxidize_tpu.runtime.engine import DeviceReduceEngine
+from map_oxidize_tpu.runtime.executor import run_map_phase
+from map_oxidize_tpu.utils.logging import get_logger
+from map_oxidize_tpu.utils.profiling import Metrics
+
+_log = get_logger(__name__)
+
+
+@dataclass
+class JobResult:
+    """What the reference reports (final_result.txt + top-10 stdout,
+    main.rs:25-28), plus metrics."""
+
+    counts: dict[bytes, int]
+    top: list[tuple[bytes, int]]
+    metrics: dict = field(default_factory=dict)
+
+    def top_report(self, k: int) -> str:
+        return format_top_words(self.top, k)
+
+
+def _readback(engine: DeviceReduceEngine, dictionary: HashDictionary):
+    """Device accumulator -> host {word_bytes: count}."""
+    hi, lo, vals, n = engine.finalize()
+    hi = np.asarray(hi[:n])
+    lo = np.asarray(lo[:n])
+    vals = np.asarray(vals[:n])
+    k64 = join_u64(hi, lo)
+    out: dict[bytes, int] = {}
+    for h, v in zip(k64.tolist(), vals.tolist()):
+        out[dictionary.lookup(h)] = v
+    return out
+
+
+def run_wordcount_job(config: JobConfig, mapper: Mapper, reducer: Reducer) -> JobResult:
+    """End-to-end word-count-shaped job (scalar sum values, string keys)."""
+    config.validate()
+    metrics = Metrics()
+
+    # --- split (plan only; chunks stream lazily — contrast main.rs:16/36-51)
+    with metrics.phase("split"):
+        if config.num_chunks > 0:
+            chunks = split_round_robin(config.input_path, config.num_chunks)
+        else:
+            _, chunk_bytes = plan_chunks(config.input_path, config.chunk_bytes)
+            chunks = iter_chunks(config.input_path, chunk_bytes)
+
+    # --- map + reduce, fused streaming phase (main.rs:19-22 were barriered)
+    engine = DeviceReduceEngine(config, reducer,
+                                value_shape=mapper.value_shape,
+                                value_dtype=mapper.value_dtype)
+    dictionary = HashDictionary()
+    records_in = 0
+    n_chunks = 0
+    with metrics.phase("map+reduce"):
+        for _idx, out in run_map_phase(
+            chunks, mapper, config.num_map_workers, config.max_retries
+        ):
+            dictionary.update(out.dictionary)
+            records_in += out.records_in
+            n_chunks += 1
+            engine.feed(out)
+
+    # --- finalize on device; read back to host strings
+    with metrics.phase("finalize"):
+        counts = _readback(engine, dictionary)
+        k = config.top_k
+        top = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))[:k]
+
+    # conservation check: every token mapped lands in exactly one count
+    # (Σ counts == Σ records_in); the reference has no such invariant check.
+    total = sum(counts.values())
+    if records_in and total != records_in:
+        raise RuntimeError(
+            f"count conservation violated: mapped {records_in} records but "
+            f"reduced counts sum to {total}"
+        )
+
+    # --- write final result (deterministic, atomic — fixes main.rs:170-182)
+    with metrics.phase("write"):
+        if config.output_path:
+            write_final_result(config.output_path, counts.items())
+
+    metrics.set("records_in", records_in)
+    metrics.set("distinct_keys", len(counts))
+    metrics.set("chunks", n_chunks)
+    metrics.set("device_rows_fed", engine.rows_fed)
+    result = JobResult(counts=counts, top=top, metrics=metrics.summary())
+    if config.metrics:
+        _log.info("metrics: %s", result.metrics)
+    return result

@@ -1,0 +1,148 @@
+"""Streaming device reduce engine (single device).
+
+The TPU-side half of the pipeline.  Where the reference materializes every
+map output to text files and re-parses them under one mutex
+(``/root/reference/src/main.rs:103-109`` spill, 111-150 reduce), this engine
+keeps a device-resident accumulator of reduced ``(key, value)`` rows and folds
+mapped batches into it as they stream in:
+
+    host map -> pad to fixed batch -> device_put -> sort+segment combine
+    (merge_into_accumulator, donated buffers, one cached XLA executable)
+
+Batches are a fixed static shape so XLA compiles exactly one merge program;
+short batches are padded with SENTINEL keys / identity values.  Dispatch is
+async, so host tokenization of chunk N overlaps device reduction of chunk
+N-1 — the double-buffering SURVEY.md §7 calls for, with no explicit machinery.
+
+Overflow safety: ``merge_into_accumulator`` reports the unique-key count of
+each merge *before* truncation to capacity; the engine polls it periodically
+and raises rather than silently dropping keys.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from map_oxidize_tpu.api import MapOutput, Reducer
+from map_oxidize_tpu.config import JobConfig
+from map_oxidize_tpu.ops.hashing import SENTINEL
+from map_oxidize_tpu.ops.segment_reduce import (
+    _identity,
+    make_accumulator,
+    merge_into_accumulator,
+)
+from map_oxidize_tpu.ops.topk import top_k_pairs_jit
+from map_oxidize_tpu.utils.logging import get_logger
+
+_log = get_logger(__name__)
+
+
+class CapacityError(RuntimeError):
+    """Distinct keys exceeded (or filled) the accumulator capacity; re-run
+    with a larger ``key_capacity``."""
+
+
+def pick_device(backend: str = "auto"):
+    """Resolve the compute device: 'tpu' demands an accelerator, 'cpu' forces
+    host, 'auto' takes jax's default ordering (accelerator first)."""
+    if backend == "auto":
+        return jax.devices()[0]
+    for d in jax.devices():
+        if d.platform == backend:
+            return d
+    if backend == "cpu":  # cpu backend exists even when an accelerator leads
+        return jax.devices("cpu")[0]
+    raise RuntimeError(f"no {backend!r} device available; have "
+                       f"{[d.platform for d in jax.devices()]}")
+
+
+class DeviceReduceEngine:
+    """Folds MapOutputs into a device accumulator with one combine monoid."""
+
+    def __init__(
+        self,
+        config: JobConfig,
+        reducer: Reducer,
+        value_shape: tuple = (),
+        value_dtype=np.int32,
+        device=None,
+        overflow_check_every: int = 64,
+    ):
+        self.config = config
+        self.combine = reducer.combine
+        self.value_shape = tuple(value_shape)
+        self.value_dtype = np.dtype(value_dtype)
+        self.device = device if device is not None else pick_device(config.backend)
+        self.batch_size = config.batch_size
+        self.capacity = config.key_capacity
+        self._pad_val = np.asarray(_identity(self.combine, self.value_dtype))
+        self._acc = jax.device_put(
+            make_accumulator(
+                self.capacity, self.value_shape, self.value_dtype, self.combine
+            ),
+            self.device,
+        )
+        self._n_unique = None
+        self._merges = 0
+        self._check_every = overflow_check_every
+        self.rows_fed = 0
+
+    def _pad(self, hi, lo, vals, start, stop):
+        b = self.batch_size
+        n = stop - start
+        p_hi = np.full(b, SENTINEL, np.uint32)
+        p_lo = np.full(b, SENTINEL, np.uint32)
+        p_vals = np.full((b,) + self.value_shape, self._pad_val, self.value_dtype)
+        p_hi[:n] = hi[start:stop]
+        p_lo[:n] = lo[start:stop]
+        p_vals[:n] = vals[start:stop]
+        return p_hi, p_lo, p_vals
+
+    def feed(self, out: MapOutput) -> None:
+        """Fold one mapped chunk into the accumulator (async dispatch)."""
+        rows = len(out)
+        self.rows_fed += rows
+        for start in range(0, max(rows, 0), self.batch_size):
+            stop = min(start + self.batch_size, rows)
+            p = self._pad(out.hi, out.lo, out.values, start, stop)
+            batch = jax.device_put(p, self.device)
+            *self._acc, self._n_unique = merge_into_accumulator(
+                *self._acc, *batch, combine=self.combine
+            )
+            self._merges += 1
+            if self._merges % self._check_every == 0:
+                self._check_overflow()
+
+    def _check_overflow(self) -> None:
+        if self._n_unique is None:
+            return
+        n = int(self._n_unique)  # host sync point
+        if n >= self.capacity:
+            raise CapacityError(
+                f"accumulator filled: {n} unique keys >= capacity "
+                f"{self.capacity}; increase key_capacity"
+            )
+
+    def finalize(self):
+        """Block, check overflow, and return ``(hi, lo, vals, n_unique)`` as
+        device arrays (padding rows past n_unique are SENTINEL/identity)."""
+        self._check_overflow()
+        n = 0 if self._n_unique is None else int(self._n_unique)
+        return (*self._acc, n)
+
+    def top_k(self, k: int):
+        """Device top-k over the current accumulator -> numpy arrays.
+
+        Only valid for the 'sum' monoid: padding rows carry the combine
+        identity, which for min/max would outrank real keys in top_k.
+        """
+        if self.combine != "sum":
+            raise ValueError("device top_k is only defined for combine='sum'")
+        hi, lo, vals, n = self.finalize()
+        if vals.ndim != 1:
+            raise ValueError("top_k requires scalar values")
+        k = min(k, self.capacity)
+        t_hi, t_lo, t_vals = top_k_pairs_jit(hi, lo, vals, k=k)
+        return np.asarray(t_hi), np.asarray(t_lo), np.asarray(t_vals), n

@@ -1,0 +1,78 @@
+"""Host map executor: the worker-pool phase engine.
+
+Replaces the reference's map pool — N tokio tasks popping a shared
+``Arc<Mutex<Vec>>`` LIFO queue (``/root/reference/src/main.rs:53-92``) — with a
+bounded ThreadPoolExecutor over a *lazy* chunk stream.  Differences that
+matter on purpose:
+
+* chunks are claimed from an iterator, so the corpus is never fully resident
+  (the reference clones the entire chunk vector into every worker,
+  main.rs:62 — 8x memory);
+* bounded in-flight submissions backpressure the reader against the device;
+* failed chunks are retried ``max_retries`` times before aborting the job —
+  the reference aborts on the first worker error (main.rs:88 ``.await??``).
+
+Python threads are the right tool here because the hot loop either runs in
+C++ with the GIL released (ctypes) or in C-speed CPython builtins
+(bytes.split/Counter); the host side only has to keep up with feeding the TPU.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import FIRST_COMPLETED, Future, ThreadPoolExecutor, wait
+from typing import Callable, Iterable, Iterator
+
+from map_oxidize_tpu.api import Mapper, MapOutput
+from map_oxidize_tpu.utils.logging import get_logger
+
+_log = get_logger(__name__)
+
+
+class MapTaskError(RuntimeError):
+    """A chunk failed all retry attempts (reference: any error kills the run,
+    main.rs:87-89; here it does so only after the retry budget)."""
+
+
+def _attempt(mapper: Mapper, chunk: bytes, index: int, max_retries: int) -> MapOutput:
+    for attempt in range(max_retries + 1):
+        try:
+            return mapper.map_chunk(chunk)
+        except Exception as e:  # noqa: BLE001 — retry any mapper failure
+            if attempt == max_retries:
+                raise MapTaskError(
+                    f"map task for chunk {index} failed after "
+                    f"{max_retries + 1} attempts: {e}"
+                ) from e
+            _log.warning("map chunk %d attempt %d failed: %s; retrying",
+                         index, attempt + 1, e)
+    raise AssertionError("unreachable")
+
+
+def run_map_phase(
+    chunks: Iterable[bytes],
+    mapper: Mapper,
+    num_workers: int,
+    max_retries: int = 2,
+) -> Iterator[tuple[int, MapOutput]]:
+    """Map chunks concurrently; yield ``(chunk_index, MapOutput)`` in
+    completion order.  At most ``2 * num_workers`` chunks are in flight, which
+    bounds host memory and backpressures the input reader."""
+    max_inflight = max(2, 2 * num_workers)
+    with ThreadPoolExecutor(max_workers=num_workers, thread_name_prefix="map") as pool:
+        inflight: dict[Future, int] = {}
+        it = enumerate(chunks)
+        exhausted = False
+        while True:
+            while not exhausted and len(inflight) < max_inflight:
+                try:
+                    idx, chunk = next(it)
+                except StopIteration:
+                    exhausted = True
+                    break
+                inflight[pool.submit(_attempt, mapper, chunk, idx, max_retries)] = idx
+            if not inflight:
+                return
+            done, _ = wait(inflight, return_when=FIRST_COMPLETED)
+            for fut in done:
+                idx = inflight.pop(fut)
+                yield idx, fut.result()  # re-raises MapTaskError

@@ -1,0 +1,53 @@
+"""Bigram count — compound string keys over a wider key space
+(BASELINE.json config #3; no reference implementation exists, so semantics are
+defined here: adjacent token pairs *within a chunk's token stream*, key string
+``"tok1 tok2"``).
+
+This exists to stress exactly what word count doesn't: key cardinality (order
+|V|^2 rather than |V|) and longer key bytes.  The device path is unchanged —
+compound keys are just another 64-bit hash — which is the point of the
+Mapper/Reducer boundary.
+
+Note on chunking: pairs that straddle a chunk boundary are not counted, and
+results are therefore a function of the chunking (documented, deterministic
+for a given config).  The parity model in tests uses the same chunking.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+
+import numpy as np
+
+from map_oxidize_tpu.api import Mapper, MapOutput, SumReducer
+from map_oxidize_tpu.ops.hashing import HashDictionary, fnv1a64_bytes, split_u64
+from map_oxidize_tpu.workloads.wordcount import tokenize
+
+
+class BigramMapper(Mapper):
+    value_shape = ()
+    value_dtype = np.int32
+
+    def __init__(self, tokenizer: str = "ascii"):
+        self.tokenizer = tokenizer
+
+    def map_chunk(self, chunk: bytes) -> MapOutput:
+        toks = tokenize(chunk, self.tokenizer)
+        pairs = Counter(
+            toks[i] + b" " + toks[i + 1] for i in range(len(toks) - 1)
+        )
+        d = HashDictionary()
+        hashes = np.empty(len(pairs), np.uint64)
+        values = np.empty(len(pairs), np.int32)
+        for i, (key, c) in enumerate(pairs.items()):
+            h = fnv1a64_bytes(key)
+            d.add(h, key)
+            hashes[i] = h
+            values[i] = c
+        hi, lo = split_u64(hashes)
+        return MapOutput(hi=hi, lo=lo, values=values, dictionary=d,
+                         records_in=max(len(toks) - 1, 0))
+
+
+def make_bigram(tokenizer: str = "ascii"):
+    return BigramMapper(tokenizer), SumReducer()

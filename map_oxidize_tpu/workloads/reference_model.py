@@ -1,0 +1,38 @@
+"""Pure-host model of the reference program's semantics.
+
+This is (a) the golden oracle for parity tests — a faithful Python rendition
+of the reference pipeline's observable behavior (tokenize per
+``/root/reference/src/main.rs:96-97``, merge per main.rs:131-134, top-k per
+main.rs:184-191) — and (b) the measured CPU baseline the ≥5× north-star
+speedup is judged against (BASELINE.md).
+
+Parity is defined on the multiset of (word, count) pairs and the
+count-ordered top-k; the reference's tie order and output line order are
+nondeterministic (HashMap iteration), so byte-identical output is not a sane
+target (SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Iterable
+
+from map_oxidize_tpu.workloads.wordcount import tokenize
+
+
+def wordcount_model(chunks: Iterable[bytes], mode: str = "ascii") -> Counter:
+    """Map every chunk, merge with += — reference semantics end to end."""
+    total: Counter = Counter()
+    for chunk in chunks:
+        total.update(tokenize(chunk, mode))  # map (main.rs:94-101) + merge (131-134)
+    return total
+
+
+def top_k_model(counts: Counter, k: int) -> list[tuple[bytes, int]]:
+    """Count-descending top-k with deterministic (word-ascending) tie-break —
+    a determinized version of main.rs:184-191.
+
+    Intentionally duplicates the driver's expression rather than importing it:
+    the oracle must stay independent of the implementation under test.
+    """
+    return sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))[:k]

@@ -1,0 +1,74 @@
+"""Word count — the reference's one and only workload.
+
+Mapper semantics follow ``/root/reference/src/main.rs:94-101`` exactly:
+whitespace-split, lowercase, **no punctuation stripping** ("the," and "the"
+are distinct keys).  Two tokenizer modes:
+
+* ``ascii`` (default): byte-level — split on ASCII whitespace, lowercase
+  ASCII letters.  This is the mode the C++ hot loop accelerates when
+  available; ``bytes.split()`` / ``bytes.lower()`` are its exact Python
+  equivalents, so native and fallback paths stay bit-identical.
+* ``unicode``: decode UTF-8 and use ``str.split()`` / ``str.lower()`` —
+  matching Rust ``split_whitespace()`` + ``to_lowercase()`` (main.rs:96-97)
+  for Unicode corpora.  (Known delta: a handful of locale-ish case mappings,
+  e.g. İ, differ between Rust and Python; both are Unicode-correct and no
+  English corpus contains them.)
+
+The mapper is a *combiner*: it counts within the chunk (as the reference's
+per-chunk ``HashMap`` effectively does) and emits one row per distinct token,
+shrinking host->HBM traffic by the chunk's duplication factor.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+
+import numpy as np
+
+from map_oxidize_tpu.api import Mapper, MapOutput, SumReducer
+from map_oxidize_tpu.ops.hashing import HashDictionary, fnv1a64_bytes, split_u64
+
+
+def tokenize(chunk: bytes, mode: str = "ascii") -> list[bytes]:
+    """Split + lowercase, per reference semantics (main.rs:96-97)."""
+    if mode == "ascii":
+        return chunk.lower().split()
+    if mode == "unicode":
+        return [t.encode("utf-8") for t in chunk.decode("utf-8").lower().split()]
+    raise ValueError(f"unknown tokenizer mode {mode!r}")
+
+
+class WordCountMapper(Mapper):
+    value_shape = ()
+    value_dtype = np.int32
+
+    def __init__(self, tokenizer: str = "ascii", use_native: bool = True):
+        self.tokenizer = tokenizer
+        self.use_native = use_native and tokenizer == "ascii"
+        self._native = None
+        if self.use_native:
+            from map_oxidize_tpu.native import bindings
+
+            self._native = bindings.load_or_none()
+
+    def map_chunk(self, chunk: bytes) -> MapOutput:
+        if self._native is not None:
+            return self._native.map_wordcount(chunk)
+        toks = tokenize(chunk, self.tokenizer)
+        counts = Counter(toks)
+        d = HashDictionary()
+        hashes = np.empty(len(counts), np.uint64)
+        values = np.empty(len(counts), np.int32)
+        for i, (tok, c) in enumerate(counts.items()):
+            h = fnv1a64_bytes(tok)
+            d.add(h, tok)
+            hashes[i] = h
+            values[i] = c
+        hi, lo = split_u64(hashes)
+        return MapOutput(hi=hi, lo=lo, values=values, dictionary=d,
+                         records_in=len(toks))
+
+
+def make_wordcount(tokenizer: str = "ascii", use_native: bool = True):
+    """(mapper, reducer) pair for the word-count workload."""
+    return WordCountMapper(tokenizer, use_native), SumReducer()

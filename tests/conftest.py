@@ -1,0 +1,23 @@
+"""Test harness: force an 8-virtual-device CPU mesh.
+
+No TPU is required to run the suite — multi-device code paths are validated on
+a fake mesh via ``--xla_force_host_platform_device_count=8`` (SURVEY.md §4).
+The env vars must be set before jax initializes its backends, hence here.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

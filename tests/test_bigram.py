@@ -1,0 +1,27 @@
+from collections import Counter
+
+from map_oxidize_tpu.config import JobConfig
+from map_oxidize_tpu.io.splitter import iter_chunks
+from map_oxidize_tpu.runtime.driver import run_wordcount_job
+from map_oxidize_tpu.workloads.bigram import make_bigram
+from map_oxidize_tpu.workloads.wordcount import tokenize
+
+
+def _bigram_model(chunks):
+    total = Counter()
+    for chunk in chunks:
+        toks = tokenize(chunk)
+        total.update(toks[i] + b" " + toks[i + 1] for i in range(len(toks) - 1))
+    return total
+
+
+def test_bigram_matches_model(tmp_path):
+    p = tmp_path / "c.txt"
+    p.write_bytes(b"the cat sat on the mat\nthe cat ran\n" * 40)
+    cfg = JobConfig(input_path=str(p), output_path="", backend="cpu",
+                    chunk_bytes=128, batch_size=128, key_capacity=2048)
+    mapper, reducer = make_bigram()
+    result = run_wordcount_job(cfg, mapper, reducer)
+    model = _bigram_model(iter_chunks(str(p), 128))
+    assert result.counts == dict(model)
+    assert result.metrics["records_in"] == sum(model.values())

@@ -1,0 +1,45 @@
+import numpy as np
+import pytest
+
+from map_oxidize_tpu.ops.hashing import (
+    HashDictionary,
+    fnv1a64,
+    hash_tokens,
+    join_u64,
+    split_u64,
+)
+
+
+def test_fnv1a64_known_vectors():
+    # Published FNV-1a 64 test vectors.
+    assert fnv1a64(b"") == 0xCBF29CE484222325
+    assert fnv1a64(b"a") == 0xAF63DC4C8601EC8C
+    assert fnv1a64(b"foobar") == 0x85944171F73967E8
+    assert fnv1a64("foobar") == fnv1a64(b"foobar")
+
+
+def test_split_join_roundtrip(rng):
+    h = rng.integers(0, 2**64, size=1000, dtype=np.uint64)
+    hi, lo = split_u64(h)
+    assert hi.dtype == np.uint32 and lo.dtype == np.uint32
+    np.testing.assert_array_equal(join_u64(hi, lo), h)
+
+
+def test_hash_tokens_order_and_dtype():
+    toks = [b"the", b"quick", b"the"]
+    out = hash_tokens(toks)
+    assert out.dtype == np.uint64
+    assert out[0] == out[2] == fnv1a64(b"the")
+    assert out[1] == fnv1a64(b"quick")
+
+
+def test_dictionary_union_and_collision():
+    d1, d2 = HashDictionary(), HashDictionary()
+    d1.add(fnv1a64(b"the"), b"the")
+    d2.add(fnv1a64(b"cat"), b"cat")
+    d1.update(d2)
+    assert d1.lookup(fnv1a64(b"cat")) == b"cat"
+    assert len(d1) == 2
+    # same-hash different-bytes must raise (collision detection)
+    with pytest.raises(ValueError):
+        d1.add(fnv1a64(b"the"), b"not-the")

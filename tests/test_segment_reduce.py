@@ -1,0 +1,137 @@
+"""Core reduce kernel tests against a pure-Python dict model — the same
+semantics as the reference's merge loop (/root/reference/src/main.rs:131-134:
+``*entry += count``), evaluated on hashed keys."""
+
+import collections
+
+import jax.numpy as jnp
+import numpy as np
+
+from map_oxidize_tpu.ops.hashing import SENTINEL, SENTINEL64, join_u64, split_u64
+from map_oxidize_tpu.ops.segment_reduce import (
+    make_accumulator,
+    merge_into_accumulator,
+    reduce_pairs,
+)
+from map_oxidize_tpu.ops.topk import top_k_pairs
+
+
+def _model_reduce(keys64, vals, combine="sum"):
+    """Reference semantics on the host: dict fold."""
+    out = {}
+    for k, v in zip(keys64.tolist(), np.asarray(vals).tolist()):
+        if k == SENTINEL64:
+            continue
+        if k not in out:
+            out[k] = v
+        elif combine == "sum":
+            out[k] = out[k] + v
+        elif combine == "min":
+            out[k] = min(out[k], v)
+        elif combine == "max":
+            out[k] = max(out[k], v)
+    return out
+
+
+def _device_result_to_dict(hi, lo, vals, n_unique):
+    n = int(n_unique)
+    k64 = join_u64(np.asarray(hi[:n]), np.asarray(lo[:n]))
+    return dict(zip(k64.tolist(), np.asarray(vals[:n]).tolist()))
+
+
+def _random_pairs(rng, n, n_keys, with_padding=False):
+    keys64 = rng.integers(0, 2**63, size=n_keys, dtype=np.uint64)
+    picks = keys64[rng.integers(0, n_keys, size=n)]
+    vals = rng.integers(1, 100, size=n).astype(np.int32)
+    if with_padding:
+        pad = rng.random(n) < 0.2
+        picks = np.where(pad, np.uint64(SENTINEL64), picks)
+        vals = np.where(pad, 0, vals).astype(np.int32)
+    hi, lo = split_u64(picks)
+    return picks, hi, lo, vals
+
+
+def test_reduce_pairs_sum_matches_dict_model(rng):
+    keys64, hi, lo, vals = _random_pairs(rng, 5000, 300)
+    o_hi, o_lo, o_vals, n_unique = reduce_pairs(jnp.array(hi), jnp.array(lo), jnp.array(vals))
+    got = _device_result_to_dict(o_hi, o_lo, o_vals, n_unique)
+    assert got == _model_reduce(keys64, vals)
+
+
+def test_reduce_pairs_min_max(rng):
+    for combine in ("min", "max"):
+        keys64, hi, lo, vals = _random_pairs(rng, 2000, 100)
+        o = reduce_pairs(jnp.array(hi), jnp.array(lo), jnp.array(vals), combine)
+        got = _device_result_to_dict(*o)
+        assert got == _model_reduce(keys64, vals, combine)
+
+
+def test_reduce_pairs_with_sentinel_padding(rng):
+    keys64, hi, lo, vals = _random_pairs(rng, 4096, 200, with_padding=True)
+    o_hi, o_lo, o_vals, n_unique = reduce_pairs(jnp.array(hi), jnp.array(lo), jnp.array(vals))
+    got = _device_result_to_dict(o_hi, o_lo, o_vals, n_unique)
+    assert got == _model_reduce(keys64, vals)
+    # rows past n_unique are sentinel/identity
+    assert np.all(np.asarray(o_hi[int(n_unique):]) == SENTINEL)
+    assert np.all(np.asarray(o_vals[int(n_unique):]) == 0)
+
+
+def test_reduce_pairs_all_padding():
+    n = 64
+    hi = jnp.full((n,), SENTINEL, jnp.uint32)
+    lo = jnp.full((n,), SENTINEL, jnp.uint32)
+    vals = jnp.zeros((n,), jnp.int32)
+    _, _, _, n_unique = reduce_pairs(hi, lo, vals)
+    assert int(n_unique) == 0
+
+
+def test_reduce_pairs_vector_values(rng):
+    """k-means-style [n, d] values reduce per-dimension."""
+    keys64 = rng.integers(0, 2**62, size=10, dtype=np.uint64)
+    picks = keys64[rng.integers(0, 10, size=500)]
+    vals = rng.normal(size=(500, 3)).astype(np.float32)
+    hi, lo = split_u64(picks)
+    o_hi, o_lo, o_vals, n_unique = reduce_pairs(jnp.array(hi), jnp.array(lo), jnp.array(vals))
+    n = int(n_unique)
+    got = {k: v for k, v in zip(join_u64(np.asarray(o_hi[:n]), np.asarray(o_lo[:n])).tolist(),
+                                np.asarray(o_vals[:n]))}
+    want = collections.defaultdict(lambda: np.zeros(3, np.float64))
+    for k, v in zip(picks.tolist(), vals):
+        want[k] += v
+    assert set(got) == set(want)
+    for k in want:
+        np.testing.assert_allclose(got[k], want[k], rtol=1e-5)
+
+
+def test_streaming_accumulator_equals_one_shot(rng):
+    """Fold 10 batches through merge_into_accumulator; must equal a single
+    global reduce (associativity of the monoid)."""
+    cap, bs = 2048, 512
+    acc = make_accumulator(cap)
+    all_keys, all_vals = [], []
+    for _ in range(10):
+        keys64, hi, lo, vals = _random_pairs(rng, bs, 150, with_padding=True)
+        all_keys.append(keys64)
+        all_vals.append(vals)
+        acc_hi, acc_lo, acc_vals, n_unique = merge_into_accumulator(
+            *acc, jnp.array(hi), jnp.array(lo), jnp.array(vals)
+        )
+        acc = (acc_hi, acc_lo, acc_vals)
+    assert int(n_unique) <= cap
+    got = _device_result_to_dict(acc_hi, acc_lo, acc_vals, n_unique)
+    want = _model_reduce(np.concatenate(all_keys), np.concatenate(all_vals))
+    assert got == want
+
+
+def test_top_k_pairs(rng):
+    keys64, hi, lo, vals = _random_pairs(rng, 3000, 50)
+    o_hi, o_lo, o_vals, n_unique = reduce_pairs(jnp.array(hi), jnp.array(lo), jnp.array(vals))
+    k = 7
+    t_hi, t_lo, t_vals = top_k_pairs(o_hi, o_lo, o_vals, k)
+    model = _model_reduce(keys64, vals)
+    want_counts = sorted(model.values(), reverse=True)[:k]
+    assert np.asarray(t_vals).tolist() == want_counts
+    got = dict(zip(join_u64(np.asarray(t_hi), np.asarray(t_lo)).tolist(),
+                   np.asarray(t_vals).tolist()))
+    for k64, c in got.items():
+        assert model[k64] == c

@@ -1,0 +1,115 @@
+"""Golden-parity tests: full pipeline vs the pure-Python reference model
+(SURVEY.md §4 test strategy — the reference itself ships no tests)."""
+
+import numpy as np
+import pytest
+
+from map_oxidize_tpu.config import JobConfig
+from map_oxidize_tpu.io.splitter import iter_chunks, split_round_robin
+from map_oxidize_tpu.runtime.driver import run_wordcount_job
+from map_oxidize_tpu.workloads.reference_model import top_k_model, wordcount_model
+from map_oxidize_tpu.workloads.wordcount import make_wordcount
+
+CORPUS = b"""To be, or not to be, that is the question:
+Whether 'tis nobler in the mind to suffer
+The slings and arrows of outrageous fortune,
+Or to take arms against a sea of troubles
+And by opposing end them. To die-to sleep,
+No more; and by a sleep to say we end
+The heart-ache and the thousand natural shocks
+That flesh is heir to: 'tis a consummation
+Devoutly to be wish'd. To die, to sleep;
+To sleep, perchance to dream-ay, there's the rub:
+"""
+
+
+@pytest.fixture
+def corpus_file(tmp_path):
+    # repeat so chunking actually kicks in
+    p = tmp_path / "shakes.txt"
+    p.write_bytes(CORPUS * 50)
+    return str(p)
+
+
+def _run(corpus_file, tmp_path, **overrides):
+    cfg = JobConfig(
+        input_path=corpus_file,
+        output_path=str(tmp_path / "final_result.txt"),
+        chunk_bytes=512,          # many small chunks
+        batch_size=256,           # many small device batches
+        key_capacity=4096,
+        backend="cpu",
+        use_native=False,
+        **overrides,
+    )
+    mapper, reducer = make_wordcount(cfg.tokenizer, cfg.use_native)
+    return cfg, run_wordcount_job(cfg, mapper, reducer)
+
+
+def test_wordcount_matches_reference_model(corpus_file, tmp_path):
+    cfg, result = _run(corpus_file, tmp_path)
+    model = wordcount_model(iter_chunks(corpus_file, 512))
+    assert result.counts == dict(model)
+    assert result.top == top_k_model(model, 10)
+
+
+def test_round_robin_compat_chunking_same_result(corpus_file, tmp_path):
+    """Byte-range chunking and the reference's round-robin line chunking
+    (main.rs:36-51) must produce identical global counts."""
+    _, streamed = _run(corpus_file, tmp_path)
+    _, rr = _run(corpus_file, tmp_path, num_chunks=8)
+    assert streamed.counts == rr.counts
+    chunks = split_round_robin(corpus_file, 8)
+    assert wordcount_model(chunks) == streamed.counts
+
+
+def test_final_result_file_deterministic_and_truncated(corpus_file, tmp_path):
+    out = tmp_path / "final_result.txt"
+    # pre-existing longer file would expose the reference's no-truncate bug
+    # (main.rs:171-175): stale trailing bytes must NOT survive.
+    out.write_bytes(b"x" * 1_000_000)
+    _, result = _run(corpus_file, tmp_path)
+    first = out.read_bytes()
+    assert len(first) < 1_000_000
+    _, result2 = _run(corpus_file, tmp_path)
+    assert out.read_bytes() == first  # byte-identical across runs
+    # file content round-trips to the counts dict
+    parsed = {}
+    for line in first.splitlines():
+        w, c = line.rsplit(b" ", 1)
+        parsed[w] = int(c)
+    assert parsed == result.counts
+
+
+def test_unicode_tokenizer_mode(tmp_path):
+    p = tmp_path / "u.txt"
+    p.write_bytes("Ärger straße Ärger ÉCLAIR\n".encode("utf-8"))
+    cfg = JobConfig(input_path=str(p), output_path="", backend="cpu",
+                    tokenizer="unicode", use_native=False,
+                    batch_size=64, key_capacity=64)
+    mapper, reducer = make_wordcount("unicode", use_native=False)
+    result = run_wordcount_job(cfg, mapper, reducer)
+    assert result.counts["ärger".encode()] == 2
+    assert result.counts["éclair".encode()] == 1
+    assert result.counts["straße".encode()] == 1
+
+
+def test_conservation_metric(corpus_file, tmp_path):
+    _, result = _run(corpus_file, tmp_path)
+    assert result.metrics["records_in"] == sum(result.counts.values())
+    assert result.metrics["distinct_keys"] == len(result.counts)
+
+
+def test_cli_smoke(corpus_file, tmp_path, capsys, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    from map_oxidize_tpu.cli import main
+
+    rc = main(["wordcount", corpus_file, "--backend", "cpu", "--no-native",
+               "--top-k", "5", "--output", str(tmp_path / "out.txt"), "-q"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert out.startswith("Top 5 words:")
+    assert len(out.strip().splitlines()) == 6
+    model = wordcount_model([open(corpus_file, "rb").read()])
+    for line, (w, c) in zip(out.strip().splitlines()[1:], top_k_model(model, 5)):
+        assert line == f"{w.decode()}: {c}"
