@@ -89,8 +89,7 @@ def main(argv: list[str] | None = None) -> int:
         print(f"error: cannot open input {config.input_path!r}", file=sys.stderr)
         return 2
     for flag, val in (("--checkpoint-dir", config.checkpoint_dir),
-                      ("--keep-intermediates", config.keep_intermediates),
-                      ("--num-shards", config.num_shards)):
+                      ("--keep-intermediates", config.keep_intermediates)):
         if val:
             _log.warning("%s is not wired into the runtime yet; ignoring", flag)
 

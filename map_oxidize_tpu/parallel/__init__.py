@@ -1,0 +1,16 @@
+"""Multi-chip execution: mesh, all_to_all shuffle, sharded reduce engine."""
+
+from map_oxidize_tpu.parallel.engine import ShardedReduceEngine, ShuffleOverflowError
+from map_oxidize_tpu.parallel.mesh import SHARD_AXIS, make_mesh, replicated, sharded
+from map_oxidize_tpu.parallel.shuffle import bucket_of, build_sharded_ops
+
+__all__ = [
+    "SHARD_AXIS",
+    "ShardedReduceEngine",
+    "ShuffleOverflowError",
+    "bucket_of",
+    "build_sharded_ops",
+    "make_mesh",
+    "replicated",
+    "sharded",
+]

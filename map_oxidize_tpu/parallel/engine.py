@@ -1,0 +1,108 @@
+"""Sharded streaming reduce engine: the multi-chip twin of
+:class:`map_oxidize_tpu.runtime.engine.DeviceReduceEngine`.
+
+Where the reference funnels every reduce into one mutex-guarded HashMap
+(``/root/reference/src/main.rs:113,131-134``), this engine keeps one
+accumulator *per shard*, each owning a hash-partition of the key space;
+batches are routed to their owners by the ``all_to_all`` exchange in
+:mod:`map_oxidize_tpu.parallel.shuffle` and folded locally.  The host sees
+the same ``feed(MapOutput)`` / ``finalize()`` / ``top_k(k)`` surface
+(:class:`~map_oxidize_tpu.runtime.engine.StreamingEngineBase`), so the driver
+is engine-agnostic — swapping 1 chip for a v4-pod slice is a config change.
+
+Host->device feeding uses global row-major arrays sharded on dim 0
+(``NamedSharding(mesh, P('shards'))``): ``jax.device_put`` splits the batch
+across chips, which doubles as the *map-side* data parallelism — each shard
+"maps" (receives) B/S of the rows, then the exchange re-partitions by key.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+from map_oxidize_tpu.api import Reducer
+from map_oxidize_tpu.config import JobConfig
+from map_oxidize_tpu.ops.segment_reduce import make_accumulator
+from map_oxidize_tpu.parallel.mesh import SHARD_AXIS, make_mesh, sharded
+from map_oxidize_tpu.parallel.shuffle import build_sharded_ops
+from map_oxidize_tpu.runtime.engine import CapacityError, StreamingEngineBase
+from map_oxidize_tpu.utils.logging import get_logger
+
+_log = get_logger(__name__)
+
+
+class ShuffleOverflowError(RuntimeError):
+    """A hash bucket exceeded the exchange-buffer capacity; rows would have
+    been dropped.  Increase ``bucket_cap`` (or shrink the batch)."""
+
+
+class ShardedReduceEngine(StreamingEngineBase):
+    """Folds MapOutputs into per-shard accumulators over a device mesh."""
+
+    def __init__(
+        self,
+        config: JobConfig,
+        reducer: Reducer,
+        value_shape: tuple = (),
+        value_dtype=np.int32,
+        mesh=None,
+        bucket_cap: int = 0,
+        overflow_check_every: int = 16,
+    ):
+        super().__init__(config, reducer, value_shape, value_dtype,
+                         overflow_check_every)
+        self.mesh = mesh if mesh is not None else make_mesh(
+            config.num_shards, config.backend
+        )
+        self.S = self.mesh.shape[SHARD_AXIS]
+        # per-shard sizes; global arrays are S x these
+        self.batch_per_shard = max(1, config.batch_size // self.S)
+        self.cap_per_shard = max(1, config.key_capacity // self.S)
+        self.feed_batch = self.batch_per_shard * self.S
+        self._sharding = sharded(self.mesh)
+
+        self._merge, self._topk = build_sharded_ops(
+            self.mesh, self.combine, bucket_cap, self.batch_per_shard
+        )
+        acc = make_accumulator(
+            self.cap_per_shard * self.S, self.value_shape, self.value_dtype,
+            self.combine,
+        )
+        self._acc = list(jax.device_put(acc, self._sharding))
+        self._n_unique = None   # [S] per-shard unique counts
+        # [S] cumulative overflow counter, threaded through every merge
+        self._overflow = jax.device_put(
+            np.zeros(self.S, np.int32), self._sharding
+        )
+
+    def _merge_batch(self, padded) -> None:
+        batch = jax.device_put(padded, self._sharding)
+        *self._acc, self._n_unique, self._overflow = self._merge(
+            *self._acc, self._overflow, *batch
+        )
+
+    def _check_health(self) -> None:
+        ovf = int(np.asarray(self._overflow)[0])  # host sync
+        if ovf:
+            raise ShuffleOverflowError(
+                f"{ovf} rows overflowed the all_to_all bucket capacity; "
+                "increase bucket_cap"
+            )
+        if self._n_unique is not None:
+            worst = int(np.max(np.asarray(self._n_unique)))
+            if worst >= self.cap_per_shard:
+                raise CapacityError(
+                    f"a shard accumulator filled: {worst} unique keys >= "
+                    f"per-shard capacity {self.cap_per_shard}; increase "
+                    "key_capacity"
+                )
+
+    def finalize(self):
+        self._check_health()
+        if self._n_unique is None:
+            return (*self._acc, 0)
+        return (*self._acc, int(np.sum(np.asarray(self._n_unique))))
+
+    def _top_k_device(self, k: int):
+        return self._topk(*self._acc, k)

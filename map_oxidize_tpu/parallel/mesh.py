@@ -1,0 +1,51 @@
+"""Device mesh construction for the sharded engine.
+
+The reference has no distributed backend at all — its "cluster" is one tokio
+process with a shared-memory mutex (``/root/reference/src/main.rs:54-55,
+112-113``).  Here the scaling axis is a ``jax.sharding.Mesh`` over however
+many chips (and hosts — ``jax.distributed`` meshes span DCN transparently)
+are available; every collective in :mod:`map_oxidize_tpu.parallel.shuffle`
+rides this mesh's ICI links.
+
+One mesh axis, ``"shards"``, carries both roles of the reference's two worker
+pools (map workers main.rs:11, reduce workers main.rs:12): each shard maps a
+slice of the input batch *and* owns a hash-partition of the key space.  The
+hand-off between the two roles is the ``all_to_all`` bucket exchange.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+SHARD_AXIS = "shards"
+
+
+def make_mesh(num_shards: int = 0, backend: str = "auto") -> Mesh:
+    """Build a 1-D mesh over ``num_shards`` devices (0 = all available).
+
+    ``backend`` narrows the device pool ('tpu'/'cpu'); 'auto' takes jax's
+    default ordering (accelerators first).
+    """
+    if backend == "auto":
+        devs = jax.devices()
+    else:
+        devs = [d for d in jax.devices() if d.platform == backend]
+        if not devs and backend == "cpu":
+            devs = jax.devices("cpu")
+    if not devs:
+        raise RuntimeError(f"no devices for backend {backend!r}")
+    n = num_shards if num_shards > 0 else len(devs)
+    if n > len(devs):
+        raise RuntimeError(f"requested {n} shards but only {len(devs)} devices")
+    return Mesh(np.asarray(devs[:n]), (SHARD_AXIS,))
+
+
+def sharded(mesh: Mesh) -> NamedSharding:
+    """Sharding for row-major global arrays split on dim 0 across shards."""
+    return NamedSharding(mesh, PartitionSpec(SHARD_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

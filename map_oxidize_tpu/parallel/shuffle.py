@@ -1,0 +1,205 @@
+"""Hash-bucket ``all_to_all`` shuffle + sharded segment-reduce.
+
+This is the TPU-native replacement for the reference's shuffle, which does not
+exist: every reduce worker merges into ONE global ``HashMap`` under ONE mutex
+(``/root/reference/src/main.rs:111-150``, lock at 131), so its reduce is
+serialized and its key space is never partitioned.  On a mesh the idiomatic
+formulation is owner-computes over a hash partition of the key space:
+
+    per shard: bucket rows by ``hash % num_shards``  ->  sort by bucket  ->
+    scatter into a fixed [S, cap] send buffer  ->  ``lax.all_to_all`` over
+    ICI  ->  every row now sits on its owner shard  ->  local sort +
+    segment-combine into that shard's accumulator.
+
+Ragged bucket sizes (SURVEY.md §7 hard part (b)) are handled by
+pad-to-capacity: the send buffer gives every destination shard ``cap`` slots,
+padding carries SENTINEL keys, and per-bucket overflow is *counted* (psum over
+shards) and returned so the host can raise instead of silently dropping rows.
+With a healthy hash, bucket loads concentrate near B/S, so ``cap ~ 2B/S`` is
+ample slack; the engine exposes the knob.
+
+Global top-k is two-level: per-shard ``lax.top_k`` over the local accumulator,
+``all_gather`` of the S*k candidates (k rows per shard cross ICI, not the
+whole key space), final ``top_k`` replicated.  This replaces the reference's
+full host-side sort of every distinct word (main.rs:184-192).
+
+Everything here is shape-static and compiles to one XLA program per
+(batch, capacity, k) config; collectives are XLA's own ICI/DCN lowering —
+no NCCL/MPI analog exists or is needed.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from map_oxidize_tpu.ops.hashing import SENTINEL
+from map_oxidize_tpu.ops.segment_reduce import reduce_pairs
+from map_oxidize_tpu.parallel.mesh import SHARD_AXIS
+
+
+def bucket_of(hi: jnp.ndarray, lo: jnp.ndarray, num_shards: int) -> jnp.ndarray:
+    """Owner shard of a 64-bit key.  Mixes both planes (FNV-1a's low bits
+    alone are its weakest) and must match any host-side partitioner."""
+    return ((hi ^ lo) % jnp.uint32(num_shards)).astype(jnp.int32)
+
+
+def _exchange(hi, lo, vals, num_shards: int, cap: int):
+    """Per-shard body: route rows to their owner shard via all_to_all.
+
+    Returns ``(hi, lo, vals)`` of shape ``[S*cap, ...]`` — the rows this shard
+    owns after the exchange — plus the global count of overflow-dropped rows
+    (replicated scalar; caller raises on nonzero).
+    """
+    B = hi.shape[0]
+    S = num_shards
+    is_pad = (hi == jnp.uint32(SENTINEL)) & (lo == jnp.uint32(SENTINEL))
+    # padding rows are spread round-robin so they never overflow one bucket
+    rr = (jnp.arange(B, dtype=jnp.int32) % S)
+    dest = jnp.where(is_pad, rr, bucket_of(hi, lo, S))
+
+    # stable sort by destination; values ride as a permutation index
+    idx = jnp.arange(B, dtype=jnp.int32)
+    dest_s, perm = lax.sort((dest, idx), num_keys=1, is_stable=True)
+    hi_s = jnp.take(hi, perm)
+    lo_s = jnp.take(lo, perm)
+    vals_s = jnp.take(vals, perm, axis=0)
+
+    counts = jnp.bincount(dest, length=S)
+    starts = jnp.cumsum(counts) - counts
+    rank = idx - jnp.take(starts, dest_s)  # position within the bucket
+    overflow = jnp.sum(jnp.maximum(counts - cap, 0))
+
+    # scatter into the [S, cap] send buffer; rank >= cap rows are dropped
+    # (mode='drop') and accounted for by `overflow`
+    buf_hi = jnp.full((S, cap), SENTINEL, jnp.uint32)
+    buf_lo = jnp.full((S, cap), SENTINEL, jnp.uint32)
+    buf_vals = jnp.zeros((S, cap) + vals.shape[1:], vals.dtype)
+    buf_hi = buf_hi.at[dest_s, rank].set(hi_s, mode="drop")
+    buf_lo = buf_lo.at[dest_s, rank].set(lo_s, mode="drop")
+    buf_vals = buf_vals.at[dest_s, rank].set(vals_s, mode="drop")
+
+    # ICI exchange: row block [d, :] goes to shard d; received block i came
+    # from shard i.  tiled=True keeps the [S, cap] shape.
+    ex_hi = lax.all_to_all(buf_hi, SHARD_AXIS, 0, 0, tiled=True)
+    ex_lo = lax.all_to_all(buf_lo, SHARD_AXIS, 0, 0, tiled=True)
+    ex_vals = lax.all_to_all(buf_vals, SHARD_AXIS, 0, 0, tiled=True)
+
+    total_overflow = lax.psum(overflow, SHARD_AXIS)
+    flat = (S * cap,)
+    return (
+        ex_hi.reshape(flat),
+        ex_lo.reshape(flat),
+        ex_vals.reshape(flat + vals.shape[1:]),
+        total_overflow,
+    )
+
+
+def _merge_step(acc_hi, acc_lo, acc_vals, ovf_in, b_hi, b_lo, b_vals,
+                num_shards: int, cap: int, combine: str):
+    """Per-shard body of one streaming fold: pre-combine the local batch,
+    shuffle it, then sort+segment-combine into this shard's accumulator.
+    ``ovf_in`` is the running overflow counter — carried through the step so
+    no merge's drops can be shadowed by a later clean merge."""
+    C = acc_hi.shape[0]
+    # Local pre-combine (a device-side "combiner"): collapses duplicate keys
+    # before the exchange, so per-bucket load scales with the batch's
+    # *distinct* keys, not its token multiplicity — a Zipf-skewed batch would
+    # otherwise concentrate one hot key's duplicates into one bucket and
+    # overflow cap.  Also shrinks ICI bytes by the duplication factor, and the
+    # sort it costs was going to be paid post-exchange anyway.
+    b_hi, b_lo, b_vals, _ = reduce_pairs(b_hi, b_lo, b_vals, combine)
+    r_hi, r_lo, r_vals, overflow = _exchange(b_hi, b_lo, b_vals, num_shards, cap)
+    hi = jnp.concatenate([acc_hi, r_hi])
+    lo = jnp.concatenate([acc_lo, r_lo])
+    vals = jnp.concatenate([acc_vals, r_vals])
+    u_hi, u_lo, u_vals, n_unique = reduce_pairs(hi, lo, vals, combine)
+    return (
+        u_hi[:C],
+        u_lo[:C],
+        u_vals[:C],
+        n_unique.reshape(1),            # per-shard unique count -> [S] global
+        ovf_in + overflow.reshape(1),   # cumulative; replicated value carried
+                                        # per-shard so the out_spec is uniform
+    )
+
+
+def _topk_step(acc_hi, acc_lo, acc_vals, k_local: int, k_final: int):
+    """Per-shard body: local candidates -> all_gather -> global top-k.
+
+    ``k_local = min(k, per-shard capacity)`` is *complete*: a shard holds at
+    most capacity distinct keys, so when k exceeds capacity its whole
+    accumulator is its candidate set and nothing can be missed.  The final
+    top-k runs over all ``S * k_local`` gathered candidates and returns
+    ``k_final = min(k, S * k_local)`` rows.  Only the 'sum' monoid is
+    eligible (padding carries 0, losing to any positive count) — mirrors the
+    single-device engine's restriction."""
+    v, i = lax.top_k(acc_vals, k_local)
+    h = jnp.take(acc_hi, i)
+    l = jnp.take(acc_lo, i)
+    gh = lax.all_gather(h, SHARD_AXIS, tiled=True)   # [S*k_local]
+    gl = lax.all_gather(l, SHARD_AXIS, tiled=True)
+    gv = lax.all_gather(v, SHARD_AXIS, tiled=True)
+    fv, fi = lax.top_k(gv, k_final)
+    return jnp.take(gh, fi), jnp.take(gl, fi), fv
+
+
+def build_sharded_ops(mesh, combine: str = "sum", bucket_cap: int = 0,
+                      batch_per_shard: int = 0):
+    """Compile the sharded merge step and top-k for ``mesh``.
+
+    Returns ``(merge_fn, topk_fn)``:
+
+    * ``merge_fn(acc_hi, acc_lo, acc_vals, ovf, b_hi, b_lo, b_vals)`` — all
+      args global row-major arrays sharded on dim 0; returns updated
+      accumulator triple (donated, stays in HBM), per-shard unique counts
+      ``[S]`` and the cumulative overflow counter ``[S]`` (all entries equal;
+      nonzero = rows were dropped, caller must raise).
+    * ``topk_fn(acc_hi, acc_lo, acc_vals, k)`` — replicated
+      ``(hi_k, lo_k, vals_k)``.
+
+    ``bucket_cap`` = slots per destination shard in the exchange buffer.  0
+    derives ``2*ceil(B/S) + 16``: expected load is B/S, doubled for hash
+    variance, plus slack for the round-robin padding rows (at most
+    ``ceil(B/S)`` per bucket) on short batches.
+    """
+    S = mesh.shape[SHARD_AXIS]
+    if bucket_cap <= 0:
+        if batch_per_shard <= 0:
+            raise ValueError("need bucket_cap or batch_per_shard")
+        bucket_cap = min(batch_per_shard, 2 * (-(-batch_per_shard // S)) + 16)
+
+    spec = P(SHARD_AXIS)
+    merge = jax.shard_map(
+        partial(_merge_step, num_shards=S, cap=bucket_cap, combine=combine),
+        mesh=mesh,
+        in_specs=(spec,) * 7,
+        out_specs=(spec, spec, spec, spec, spec),
+    )
+    merge = jax.jit(merge, donate_argnums=(0, 1, 2, 3))
+
+    @lru_cache(maxsize=None)
+    def _topk_compiled(k_local: int, k_final: int):
+        # check_vma=False: the result of top_k over an all_gather IS
+        # replicated, but shard_map's static replication checker can't prove
+        # it through the take/top_k composition.
+        f = jax.shard_map(
+            partial(_topk_step, k_local=k_local, k_final=k_final),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        )
+        return jax.jit(f)
+
+    def topk_fn(acc_hi, acc_lo, acc_vals, k: int):
+        cap_per_shard = acc_hi.shape[0] // S
+        k_local = min(k, cap_per_shard)
+        k_final = min(k, S * k_local)
+        return _topk_compiled(k_local, k_final)(acc_hi, acc_lo, acc_vals)
+
+    return merge, topk_fn

@@ -18,8 +18,8 @@ from map_oxidize_tpu.api import Mapper, Reducer
 from map_oxidize_tpu.config import JobConfig
 from map_oxidize_tpu.io.splitter import iter_chunks, plan_chunks, split_round_robin
 from map_oxidize_tpu.io.writer import format_top_words, write_final_result
-from map_oxidize_tpu.ops.hashing import HashDictionary, join_u64
-from map_oxidize_tpu.runtime.engine import DeviceReduceEngine
+from map_oxidize_tpu.ops.hashing import SENTINEL, HashDictionary, join_u64
+from map_oxidize_tpu.runtime.engine import DeviceReduceEngine, StreamingEngineBase
 from map_oxidize_tpu.runtime.executor import run_map_phase
 from map_oxidize_tpu.utils.logging import get_logger
 from map_oxidize_tpu.utils.profiling import Metrics
@@ -40,16 +40,44 @@ class JobResult:
         return format_top_words(self.top, k)
 
 
-def _readback(engine: DeviceReduceEngine, dictionary: HashDictionary):
-    """Device accumulator -> host {word_bytes: count}."""
+def make_engine(config: JobConfig, reducer, value_shape=(), value_dtype=np.int32
+                ) -> StreamingEngineBase:
+    """Pick the engine for the configured shard count: ``num_shards == 1``
+    (or 0 with one visible device) runs single-chip; anything wider builds a
+    mesh and the all_to_all sharded engine."""
+    import jax
+
+    n = config.num_shards
+    if n == 0:
+        pool = jax.devices() if config.backend == "auto" else [
+            d for d in jax.devices() if d.platform == config.backend
+        ] or jax.devices("cpu")
+        n = len(pool)
+    if n <= 1:
+        return DeviceReduceEngine(config, reducer, value_shape=value_shape,
+                                  value_dtype=value_dtype)
+    from map_oxidize_tpu.parallel.engine import ShardedReduceEngine
+
+    return ShardedReduceEngine(config, reducer, value_shape=value_shape,
+                               value_dtype=value_dtype)
+
+
+def _readback(engine: StreamingEngineBase, dictionary: HashDictionary):
+    """Device accumulator -> host {word_bytes: count}.  Padding rows carry
+    the SENTINEL key and may sit anywhere (engine contract), so mask."""
     hi, lo, vals, n = engine.finalize()
-    hi = np.asarray(hi[:n])
-    lo = np.asarray(lo[:n])
-    vals = np.asarray(vals[:n])
-    k64 = join_u64(hi, lo)
+    hi = np.asarray(hi)
+    lo = np.asarray(lo)
+    vals = np.asarray(vals)
+    live = ~((hi == np.uint32(SENTINEL)) & (lo == np.uint32(SENTINEL)))
+    k64 = join_u64(hi[live], lo[live])
     out: dict[bytes, int] = {}
-    for h, v in zip(k64.tolist(), vals.tolist()):
+    for h, v in zip(k64.tolist(), vals[live].tolist()):
         out[dictionary.lookup(h)] = v
+    if len(out) != n:
+        raise RuntimeError(
+            f"readback found {len(out)} live keys but engine reported {n}"
+        )
     return out
 
 
@@ -67,9 +95,9 @@ def run_wordcount_job(config: JobConfig, mapper: Mapper, reducer: Reducer) -> Jo
             chunks = iter_chunks(config.input_path, chunk_bytes)
 
     # --- map + reduce, fused streaming phase (main.rs:19-22 were barriered)
-    engine = DeviceReduceEngine(config, reducer,
-                                value_shape=mapper.value_shape,
-                                value_dtype=mapper.value_dtype)
+    engine = make_engine(config, reducer,
+                         value_shape=mapper.value_shape,
+                         value_dtype=mapper.value_dtype)
     dictionary = HashDictionary()
     records_in = 0
     n_chunks = 0

@@ -1,29 +1,41 @@
-"""Streaming device reduce engine (single device).
+"""Streaming device reduce engines.
 
 The TPU-side half of the pipeline.  Where the reference materializes every
 map output to text files and re-parses them under one mutex
-(``/root/reference/src/main.rs:103-109`` spill, 111-150 reduce), this engine
-keeps a device-resident accumulator of reduced ``(key, value)`` rows and folds
-mapped batches into it as they stream in:
+(``/root/reference/src/main.rs:103-109`` spill, 111-150 reduce), these
+engines keep a device-resident accumulator of reduced ``(key, value)`` rows
+and fold mapped batches into it as they stream in:
 
     host map -> pad to fixed batch -> device_put -> sort+segment combine
-    (merge_into_accumulator, donated buffers, one cached XLA executable)
+    (donated buffers, one cached XLA executable)
 
 Batches are a fixed static shape so XLA compiles exactly one merge program;
 short batches are padded with SENTINEL keys / identity values.  Dispatch is
 async, so host tokenization of chunk N overlaps device reduction of chunk
 N-1 — the double-buffering SURVEY.md §7 calls for, with no explicit machinery.
 
-Overflow safety: ``merge_into_accumulator`` reports the unique-key count of
-each merge *before* truncation to capacity; the engine polls it periodically
-and raises rather than silently dropping keys.
+Two implementations share the host-side surface (``feed`` / ``finalize`` /
+``top_k``), so the driver is engine-agnostic:
+
+* :class:`DeviceReduceEngine` — one chip, one accumulator.
+* :class:`map_oxidize_tpu.parallel.engine.ShardedReduceEngine` — a mesh of
+  chips, per-shard accumulators, ``all_to_all`` key routing.
+
+Engine contract for ``finalize()``: returns ``(hi, lo, vals, n_unique)``
+device arrays where rows whose key is SENTINEL are padding and **may appear
+anywhere** (the sharded layout interleaves each shard's padding tail);
+consumers must mask on the sentinel, not slice ``[:n]``.
+
+Overflow safety: every merge reports unique-key counts; engines poll them
+periodically and raise rather than silently dropping keys.
 """
 
 from __future__ import annotations
 
+import abc
+
 import numpy as np
 import jax
-import jax.numpy as jnp
 
 from map_oxidize_tpu.api import MapOutput, Reducer
 from map_oxidize_tpu.config import JobConfig
@@ -58,8 +70,13 @@ def pick_device(backend: str = "auto"):
                        f"{[d.platform for d in jax.devices()]}")
 
 
-class DeviceReduceEngine:
-    """Folds MapOutputs into a device accumulator with one combine monoid."""
+class StreamingEngineBase(abc.ABC):
+    """Shared host-side surface: fixed-shape batch padding, the feed loop,
+    and the health-check cadence.  Subclasses own the device state and the
+    merge executable."""
+
+    #: rows per padded device batch; set by subclass __init__
+    feed_batch: int
 
     def __init__(
         self,
@@ -67,30 +84,21 @@ class DeviceReduceEngine:
         reducer: Reducer,
         value_shape: tuple = (),
         value_dtype=np.int32,
-        device=None,
         overflow_check_every: int = 64,
     ):
         self.config = config
         self.combine = reducer.combine
         self.value_shape = tuple(value_shape)
         self.value_dtype = np.dtype(value_dtype)
-        self.device = device if device is not None else pick_device(config.backend)
-        self.batch_size = config.batch_size
-        self.capacity = config.key_capacity
         self._pad_val = np.asarray(_identity(self.combine, self.value_dtype))
-        self._acc = jax.device_put(
-            make_accumulator(
-                self.capacity, self.value_shape, self.value_dtype, self.combine
-            ),
-            self.device,
-        )
-        self._n_unique = None
         self._merges = 0
         self._check_every = overflow_check_every
         self.rows_fed = 0
 
     def _pad(self, hi, lo, vals, start, stop):
-        b = self.batch_size
+        """Copy rows [start:stop) into fresh SENTINEL/identity-padded arrays
+        of the fixed feed-batch shape."""
+        b = self.feed_batch
         n = stop - start
         p_hi = np.full(b, SENTINEL, np.uint32)
         p_lo = np.full(b, SENTINEL, np.uint32)
@@ -104,18 +112,78 @@ class DeviceReduceEngine:
         """Fold one mapped chunk into the accumulator (async dispatch)."""
         rows = len(out)
         self.rows_fed += rows
-        for start in range(0, max(rows, 0), self.batch_size):
-            stop = min(start + self.batch_size, rows)
-            p = self._pad(out.hi, out.lo, out.values, start, stop)
-            batch = jax.device_put(p, self.device)
-            *self._acc, self._n_unique = merge_into_accumulator(
-                *self._acc, *batch, combine=self.combine
-            )
+        for start in range(0, max(rows, 0), self.feed_batch):
+            stop = min(start + self.feed_batch, rows)
+            self._merge_batch(self._pad(out.hi, out.lo, out.values, start, stop))
             self._merges += 1
             if self._merges % self._check_every == 0:
-                self._check_overflow()
+                self._check_health()
 
-    def _check_overflow(self) -> None:
+    @abc.abstractmethod
+    def _merge_batch(self, padded) -> None:
+        """Fold one padded ``(hi, lo, vals)`` batch into device state."""
+
+    @abc.abstractmethod
+    def _check_health(self) -> None:
+        """Raise if keys were dropped or capacity filled (host sync point)."""
+
+    @abc.abstractmethod
+    def finalize(self):
+        """Block + health-check; return ``(hi, lo, vals, n_unique)`` per the
+        engine contract (SENTINEL rows are padding — mask, don't slice)."""
+
+    @abc.abstractmethod
+    def _top_k_device(self, k: int):
+        """Device top-k over the accumulator -> (hi_k, lo_k, vals_k)."""
+
+    def top_k(self, k: int):
+        """Device top-k over the current accumulator -> numpy arrays plus the
+        distinct-key count.
+
+        Only valid for the 'sum' monoid: padding rows carry the combine
+        identity, which for min/max would outrank real keys in top_k.
+        """
+        if self.combine != "sum":
+            raise ValueError("device top_k is only defined for combine='sum'")
+        if self.value_shape != ():
+            raise ValueError("top_k requires scalar values")
+        *_, n = self.finalize()
+        t_hi, t_lo, t_vals = self._top_k_device(k)
+        return np.asarray(t_hi), np.asarray(t_lo), np.asarray(t_vals), n
+
+
+class DeviceReduceEngine(StreamingEngineBase):
+    """Single-device engine: one accumulator, no collectives."""
+
+    def __init__(
+        self,
+        config: JobConfig,
+        reducer: Reducer,
+        value_shape: tuple = (),
+        value_dtype=np.int32,
+        device=None,
+        overflow_check_every: int = 64,
+    ):
+        super().__init__(config, reducer, value_shape, value_dtype,
+                         overflow_check_every)
+        self.device = device if device is not None else pick_device(config.backend)
+        self.feed_batch = config.batch_size
+        self.capacity = config.key_capacity
+        self._acc = list(jax.device_put(
+            make_accumulator(
+                self.capacity, self.value_shape, self.value_dtype, self.combine
+            ),
+            self.device,
+        ))
+        self._n_unique = None
+
+    def _merge_batch(self, padded) -> None:
+        batch = jax.device_put(padded, self.device)
+        *self._acc, self._n_unique = merge_into_accumulator(
+            *self._acc, *batch, combine=self.combine
+        )
+
+    def _check_health(self) -> None:
         if self._n_unique is None:
             return
         n = int(self._n_unique)  # host sync point
@@ -126,23 +194,10 @@ class DeviceReduceEngine:
             )
 
     def finalize(self):
-        """Block, check overflow, and return ``(hi, lo, vals, n_unique)`` as
-        device arrays (padding rows past n_unique are SENTINEL/identity)."""
-        self._check_overflow()
+        self._check_health()
         n = 0 if self._n_unique is None else int(self._n_unique)
         return (*self._acc, n)
 
-    def top_k(self, k: int):
-        """Device top-k over the current accumulator -> numpy arrays.
-
-        Only valid for the 'sum' monoid: padding rows carry the combine
-        identity, which for min/max would outrank real keys in top_k.
-        """
-        if self.combine != "sum":
-            raise ValueError("device top_k is only defined for combine='sum'")
-        hi, lo, vals, n = self.finalize()
-        if vals.ndim != 1:
-            raise ValueError("top_k requires scalar values")
-        k = min(k, self.capacity)
-        t_hi, t_lo, t_vals = top_k_pairs_jit(hi, lo, vals, k=k)
-        return np.asarray(t_hi), np.asarray(t_lo), np.asarray(t_vals), n
+    def _top_k_device(self, k: int):
+        hi, lo, vals = self._acc
+        return top_k_pairs_jit(hi, lo, vals, k=min(k, self.capacity))

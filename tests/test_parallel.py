@@ -1,0 +1,193 @@
+"""Sharded engine tests on the fake 8-device CPU mesh (SURVEY.md §4).
+
+Ground truth is always plain Python dict-merge over the same hashed rows —
+the sharded path must agree exactly with both it and the single-device
+engine, for any shard count that divides the mesh.
+"""
+
+import numpy as np
+import pytest
+
+from map_oxidize_tpu.api import MapOutput, SumReducer, MinReducer
+from map_oxidize_tpu.config import JobConfig
+from map_oxidize_tpu.ops.hashing import HashDictionary, join_u64, SENTINEL, SENTINEL64
+from map_oxidize_tpu.parallel import ShardedReduceEngine, make_mesh
+from map_oxidize_tpu.runtime.engine import DeviceReduceEngine
+
+
+def _rows(rng, n, key_space):
+    keys = rng.integers(0, key_space, size=n, dtype=np.uint64)
+    # avoid the (astronomically unlikely in practice) sentinel key
+    keys = np.where(keys == np.uint64(SENTINEL64), np.uint64(0), keys)
+    vals = rng.integers(1, 10, size=n, dtype=np.int32)
+    hi = (keys >> np.uint64(32)).astype(np.uint32)
+    lo = (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    return hi, lo, vals, keys
+
+
+def _truth(keys, vals, combine="sum"):
+    out = {}
+    for k, v in zip(keys.tolist(), vals.tolist()):
+        if combine == "sum":
+            out[k] = out.get(k, 0) + v
+        elif combine == "min":
+            out[k] = min(out.get(k, 1 << 62), v)
+    return out
+
+
+def _readback(engine):
+    hi, lo, vals, n = engine.finalize()
+    hi = np.asarray(hi)
+    lo = np.asarray(lo)
+    vals = np.asarray(vals)
+    live = ~((hi == np.uint32(SENTINEL)) & (lo == np.uint32(SENTINEL)))
+    k64 = join_u64(hi[live], lo[live])
+    return dict(zip(k64.tolist(), vals[live].tolist())), n
+
+
+@pytest.mark.parametrize("num_shards", [1, 2, 8])
+def test_sharded_matches_truth(rng, num_shards):
+    cfg = JobConfig(batch_size=512, key_capacity=4096, backend="cpu",
+                    num_shards=num_shards)
+    eng = ShardedReduceEngine(cfg, SumReducer())
+    hi, lo, vals, keys = _rows(rng, 3000, key_space=500)
+    d = HashDictionary()
+    # feed in 3 uneven chunks to exercise padding + multiple merges
+    for sl in (slice(0, 1000), slice(1000, 1700), slice(1700, 3000)):
+        eng.feed(MapOutput(hi=hi[sl], lo=lo[sl], values=vals[sl], dictionary=d))
+    got, n = _readback(eng)
+    want = _truth(keys, vals)
+    assert got == want
+    assert n == len(want)
+
+
+def test_sharded_matches_single_device(rng):
+    cfg = JobConfig(batch_size=256, key_capacity=2048, backend="cpu",
+                    num_shards=8)
+    hi, lo, vals, keys = _rows(rng, 2000, key_space=300)
+    d = HashDictionary()
+    out = MapOutput(hi=hi, lo=lo, values=vals, dictionary=d)
+
+    sharded = ShardedReduceEngine(cfg, SumReducer())
+    sharded.feed(out)
+    single = DeviceReduceEngine(cfg, SumReducer())
+    single.feed(out)
+
+    got_s, n_s = _readback(sharded)
+    hi1, lo1, vals1, n1 = single.finalize()
+    hi1, lo1, vals1 = np.asarray(hi1)[:n1], np.asarray(lo1)[:n1], np.asarray(vals1)[:n1]
+    got_1 = dict(zip(join_u64(hi1, lo1).tolist(), vals1.tolist()))
+    assert got_s == got_1
+    assert n_s == n1
+
+
+def test_sharded_topk(rng):
+    cfg = JobConfig(batch_size=512, key_capacity=4096, backend="cpu",
+                    num_shards=8)
+    eng = ShardedReduceEngine(cfg, SumReducer())
+    hi, lo, vals, keys = _rows(rng, 4000, key_space=200)
+    eng.feed(MapOutput(hi=hi, lo=lo, values=vals, dictionary=HashDictionary()))
+    t_hi, t_lo, t_vals, n = eng.top_k(10)
+    want = sorted(_truth(keys, vals).items(), key=lambda kv: -kv[1])[:10]
+    got_counts = sorted(t_vals.tolist(), reverse=True)
+    assert got_counts == [c for _, c in want]
+    # every returned key's count matches the truth
+    truth = _truth(keys, vals)
+    for h, v in zip(join_u64(t_hi, t_lo).tolist(), t_vals.tolist()):
+        assert truth[h] == v
+
+
+def test_sharded_min_monoid(rng):
+    cfg = JobConfig(batch_size=256, key_capacity=2048, backend="cpu",
+                    num_shards=4)
+    eng = ShardedReduceEngine(cfg, MinReducer())
+    hi, lo, vals, keys = _rows(rng, 1500, key_space=100)
+    eng.feed(MapOutput(hi=hi, lo=lo, values=vals, dictionary=HashDictionary()))
+    got, n = _readback(eng)
+    want = _truth(keys, vals, "min")
+    assert got == want
+
+
+def test_skewed_batch_no_overflow(rng):
+    """A Zipf-hot key must not overflow the exchange: the local pre-combine
+    collapses duplicates before routing, so bucket load tracks distinct keys."""
+    cfg = JobConfig(batch_size=512, key_capacity=4096, backend="cpu",
+                    num_shards=8)
+    eng = ShardedReduceEngine(cfg, SumReducer())
+    n = 512
+    keys = rng.integers(0, 260, size=n, dtype=np.uint64)
+    keys[: n // 2] = 7  # one key is half the batch
+    vals = np.ones(n, np.int32)
+    hi = (keys >> np.uint64(32)).astype(np.uint32)
+    lo = (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    eng.feed(MapOutput(hi=hi, lo=lo, values=vals, dictionary=HashDictionary()))
+    got, _ = _readback(eng)
+    assert got == _truth(keys, vals)
+
+
+def test_topk_wider_than_shard_capacity(rng):
+    """k > per-shard capacity must not silently truncate: each shard's whole
+    accumulator is gathered, so up to min(k, S*cap) rows come back."""
+    cfg = JobConfig(batch_size=512, key_capacity=64, backend="cpu",
+                    num_shards=8)  # cap_per_shard = 8
+    eng = ShardedReduceEngine(cfg, SumReducer())
+    n = 400
+    keys = rng.permutation(40).astype(np.uint64)  # 40 distinct keys
+    keys = np.concatenate([keys] * 10)[:n]
+    vals = np.ones(n, np.int32)
+    hi = (keys >> np.uint64(32)).astype(np.uint32)
+    lo = (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    eng.feed(MapOutput(hi=hi, lo=lo, values=vals, dictionary=HashDictionary()))
+    t_hi, t_lo, t_vals, cnt = eng.top_k(30)  # 30 > cap_per_shard=8
+    truth = _truth(keys, vals)
+    assert cnt == len(truth) == 40
+    got = dict(zip(join_u64(t_hi, t_lo).tolist(), t_vals.tolist()))
+    live = {h: v for h, v in got.items() if v > 0}
+    assert len(live) == 30
+    for h, v in live.items():
+        assert truth[h] == v
+
+
+def test_driver_e2e_sharded(tmp_path, rng):
+    """Full driver run through the sharded engine (8 fake devices)."""
+    from map_oxidize_tpu.runtime.driver import run_wordcount_job
+    from map_oxidize_tpu.workloads.wordcount import make_wordcount
+    from map_oxidize_tpu.workloads.reference_model import wordcount_model
+
+    corpus = tmp_path / "c.txt"
+    words = ["The", "the", "fox,", "dog", "a", "over", "Lazy"]
+    text = "\n".join(" ".join(rng.choice(words, size=9)) for _ in range(200))
+    corpus.write_text(text)
+    cfg = JobConfig(input_path=str(corpus), output_path=str(tmp_path / "o.txt"),
+                    backend="cpu", num_shards=8, batch_size=256,
+                    key_capacity=1024, use_native=False)
+    mapper, reducer = make_wordcount("ascii", use_native=False)
+    res = run_wordcount_job(cfg, mapper, reducer)
+    want = wordcount_model([text.encode()])
+    assert res.counts == dict(want)
+
+
+def test_sharded_vector_values(rng):
+    """k-means-shaped payloads: [n, d] rows reduce per-dimension."""
+    cfg = JobConfig(batch_size=256, key_capacity=1024, backend="cpu",
+                    num_shards=4)
+    eng = ShardedReduceEngine(cfg, SumReducer(), value_shape=(3,),
+                              value_dtype=np.float32)
+    n = 1000
+    keys = rng.integers(0, 50, size=n, dtype=np.uint64)
+    vecs = rng.normal(size=(n, 3)).astype(np.float32)
+    hi = (keys >> np.uint64(32)).astype(np.uint32)
+    lo = (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    eng.feed(MapOutput(hi=hi, lo=lo, values=vecs, dictionary=HashDictionary()))
+    hi_a, lo_a, vals_a, cnt = eng.finalize()
+    hi_a, lo_a, vals_a = np.asarray(hi_a), np.asarray(lo_a), np.asarray(vals_a)
+    live = ~((hi_a == np.uint32(SENTINEL)) & (lo_a == np.uint32(SENTINEL)))
+    got = dict(zip(join_u64(hi_a[live], lo_a[live]).tolist(),
+                   [tuple(r) for r in vals_a[live]]))
+    for k in np.unique(keys):
+        want = vecs[keys == k].sum(axis=0)
+        # float32 sums are fold-order-dependent (pre-combine reorders them);
+        # tolerance covers the non-associativity, not a correctness slack
+        np.testing.assert_allclose(np.asarray(got[int(k)]), want,
+                                   rtol=1e-4, atol=1e-5)
+    assert cnt == len(np.unique(keys))
