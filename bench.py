@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""Headline benchmark: end-to-end word-count throughput (words/sec/chip).
+
+Prints ONE JSON line: ``{"metric", "value", "unit", "vs_baseline"}``.
+
+``vs_baseline`` is the speedup over the measured CPU reference baseline — a
+single-threaded host run of the reference program's exact semantics
+(tokenize per ``/root/reference/src/main.rs:94-101``, merge per
+main.rs:131-134; see ``workloads/reference_model.py``).  The reference
+publishes no numbers and its corpus was stripped (SURVEY.md §6), so the
+baseline is measured here, on this machine, on the same corpus — and top-k
+parity between the two runs is asserted, so the speedup is apples-to-apples.
+
+Corpus: deterministic synthetic Zipf text (seeded), cached under
+``.bench_cache/``.  Size via ``MOXT_BENCH_MB`` (default 64; the baseline is
+timed on a capped slice and rate-extrapolated since single-thread Python is
+O(minutes) at 10x that size).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+CACHE_DIR = os.path.join(REPO, ".bench_cache")
+BENCH_MB = int(os.environ.get("MOXT_BENCH_MB", "64"))
+BASELINE_CAP_MB = int(os.environ.get("MOXT_BENCH_BASELINE_CAP_MB", "8"))
+TOP_K = 10
+
+
+def make_corpus(path: str, target_mb: int) -> None:
+    """Deterministic Zipf corpus: 30k-word vocab (mixed case + punctuation
+    variants so the lowercase/no-strip semantics matter), ~12 words/line."""
+    rng = np.random.default_rng(1234)
+    v = 30_000
+    alphabet = np.frombuffer(b"abcdefghijklmnopqrstuvwxyz", np.uint8)
+    lengths = rng.integers(2, 11, size=v)
+    vocab = []
+    for i, L in enumerate(lengths):
+        w = bytes(rng.choice(alphabet, size=int(L)).tobytes())
+        r = i % 10
+        if r == 7:
+            w = w.capitalize()          # exercises lowercasing
+        elif r == 8:
+            w = w + b","                # punctuation kept, distinct key
+        elif r == 9:
+            w = w + b"."
+        vocab.append(w)
+    vocab = np.array(vocab, dtype=object)
+    # Zipf-ish rank weights (s=1.1), the realistic word-frequency shape
+    ranks = np.arange(1, v + 1, dtype=np.float64)
+    p = ranks ** -1.1
+    p /= p.sum()
+
+    target = target_mb * 1024 * 1024
+    tmp = path + ".tmp"
+    written = 0
+    with open(tmp, "wb") as f:
+        while written < target:
+            toks = rng.choice(vocab, size=1_000_000, p=p)
+            lines = []
+            for i in range(0, 1_000_000, 12):
+                lines.append(b" ".join(toks[i:i + 12]))
+            blob = b"\n".join(lines) + b"\n"
+            f.write(blob)
+            written += len(blob)
+    os.replace(tmp, path)
+
+
+def main() -> int:
+    logging.disable(logging.INFO)  # keep stdout/stderr quiet; one JSON line
+    os.makedirs(CACHE_DIR, exist_ok=True)
+    corpus = os.path.join(CACHE_DIR, f"zipf_{BENCH_MB}mb.txt")
+    if not os.path.isfile(corpus):
+        make_corpus(corpus, BENCH_MB)
+
+    from map_oxidize_tpu.config import JobConfig
+    from map_oxidize_tpu.runtime.driver import run_wordcount_job
+    from map_oxidize_tpu.workloads.reference_model import top_k_model, wordcount_model
+    from map_oxidize_tpu.workloads.wordcount import make_wordcount
+
+    # --- our pipeline (device engine on whatever chip jax offers first)
+    cfg = JobConfig(
+        input_path=corpus,
+        output_path=os.path.join(CACHE_DIR, "final_result.txt"),
+        backend="auto",
+        top_k=TOP_K,
+        metrics=False,
+    )
+    mapper, reducer = make_wordcount(cfg.tokenizer, cfg.use_native)
+    # warm the XLA cache so compile time isn't billed as throughput
+    run_wordcount_job(
+        JobConfig(input_path=corpus, output_path="", backend="auto",
+                  metrics=False, chunk_bytes=cfg.chunk_bytes), mapper, reducer
+    ) if os.environ.get("MOXT_BENCH_WARM", "1") == "1" else None
+    t0 = time.perf_counter()
+    result = run_wordcount_job(cfg, mapper, reducer)
+    ours_s = time.perf_counter() - t0
+    words = result.metrics["records_in"]
+    ours_rate = words / ours_s
+
+    # --- CPU reference baseline: single-thread, reference semantics, on a
+    # capped slice of the same corpus (rate-extrapolated; it's O(n))
+    cap = BASELINE_CAP_MB * 1024 * 1024
+    with open(corpus, "rb") as f:
+        slice_bytes = f.read(cap)
+    slice_bytes = slice_bytes[: slice_bytes.rfind(b"\n") + 1]
+    t0 = time.perf_counter()
+    base_counts = wordcount_model([slice_bytes])
+    base_s = time.perf_counter() - t0
+    base_words = sum(base_counts.values())
+    base_rate = base_words / base_s
+
+    # --- parity: our top-k on the slice must equal the model's
+    slice_cfg = JobConfig(input_path=corpus, output_path="", backend="auto",
+                          metrics=False, top_k=TOP_K)
+    if BENCH_MB * 1024 * 1024 <= cap:
+        slice_res = result
+    else:
+        tmp_slice = os.path.join(CACHE_DIR, "slice.txt")
+        with open(tmp_slice, "wb") as f:
+            f.write(slice_bytes)
+        slice_cfg.input_path = tmp_slice
+        slice_res = run_wordcount_job(slice_cfg, mapper, reducer)
+    want_top = top_k_model(base_counts, TOP_K)
+    if slice_res.top[:TOP_K] != want_top:
+        print(json.dumps({"error": "top-k parity FAILED vs reference model"}))
+        return 1
+
+    print(json.dumps({
+        "metric": "wordcount_words_per_sec_per_chip",
+        "value": round(ours_rate, 1),
+        "unit": "words/sec",
+        "vs_baseline": round(ours_rate / base_rate, 3),
+        "detail": {
+            "corpus_mb": BENCH_MB,
+            "words": int(words),
+            "end_to_end_s": round(ours_s, 3),
+            "cpu_baseline_words_per_sec": round(base_rate, 1),
+            "distinct_keys": int(result.metrics["distinct_keys"]),
+        },
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -30,6 +30,10 @@ def make_mesh(num_shards: int = 0, backend: str = "auto") -> Mesh:
     """
     if backend == "auto":
         devs = jax.devices()
+        if num_shards > len(devs):
+            # the accelerator pool is too small; the CPU platform may carry a
+            # larger virtual pool (--xla_force_host_platform_device_count)
+            devs = jax.devices("cpu")
     else:
         devs = [d for d in jax.devices() if d.platform == backend]
         if not devs and backend == "cpu":
