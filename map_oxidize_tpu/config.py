@@ -25,10 +25,14 @@ class JobConfig:
     #: target bytes per streamed chunk (whole corpus is never host-resident,
     #: unlike main.rs:36-51)
     chunk_bytes: int = 32 * 1024 * 1024
-    #: rows per device feed batch (mapped pairs are padded to this)
+    #: max rows per device feed batch; short batches are padded only to the
+    #: next power of two, so tiny chunks don't pay full-batch sort cost
     batch_size: int = 1 << 20
-    #: device accumulator capacity — upper bound on distinct keys per shard
+    #: hard upper bound on distinct keys on device (accumulator max size)
     key_capacity: int = 1 << 22
+    #: starting accumulator capacity; grows by sentinel-padding (4x steps)
+    #: toward key_capacity as distinct keys accumulate
+    initial_key_capacity: int = 1 << 16
     #: top-k to report (reference: n=10 at main.rs:28)
     top_k: int = 10
     #: 'tpu' | 'cpu' | 'auto' — auto uses whatever jax.devices() offers
@@ -60,6 +64,8 @@ class JobConfig:
             raise ValueError(f"backend must be auto|cpu|tpu, got {self.backend!r}")
         if self.batch_size <= 0 or self.key_capacity <= 0:
             raise ValueError("batch_size and key_capacity must be positive")
+        if self.initial_key_capacity <= 0:
+            raise ValueError("initial_key_capacity must be positive")
         if self.num_chunks <= 0 and self.chunk_bytes <= 0:
             raise ValueError("chunk_bytes must be positive (or set num_chunks)")
         if self.top_k <= 0 or self.num_map_workers <= 0:

@@ -1,0 +1,113 @@
+"""Build + ctypes bindings for the native map hot loop.
+
+The shared library is compiled on first use with g++ (no pybind11 in the
+image; ctypes keeps the binding layer dependency-free) and cached beside the
+source, keyed by source mtime.  The C call runs with the GIL released —
+ctypes drops it for foreign calls — so map worker threads scale across cores.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+
+from map_oxidize_tpu.api import MapOutput
+from map_oxidize_tpu.ops.hashing import HashDictionary, split_u64
+from map_oxidize_tpu.utils.logging import get_logger
+
+_log = get_logger(__name__)
+
+_SRC = os.path.join(os.path.dirname(__file__), "csrc", "moxt_native.cpp")
+_BUILD_DIR = os.path.join(os.path.dirname(__file__), "_build")
+_SO = os.path.join(_BUILD_DIR, "libmoxt_native.so")
+
+
+class _MapResult(ctypes.Structure):
+    _fields_ = [
+        ("hashes", ctypes.POINTER(ctypes.c_uint64)),
+        ("counts", ctypes.POINTER(ctypes.c_int32)),
+        ("tok_off", ctypes.POINTER(ctypes.c_int64)),
+        ("tok_bytes", ctypes.POINTER(ctypes.c_uint8)),
+        ("n_unique", ctypes.c_int64),
+        ("n_tokens", ctypes.c_int64),
+        ("error", ctypes.c_int32),
+    ]
+
+
+def _compile() -> str:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    if (os.path.isfile(_SO)
+            and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
+        return _SO
+    # build to a temp name + atomic rename so concurrent importers are safe
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_BUILD_DIR)
+    os.close(fd)
+    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+           _SRC, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except subprocess.CalledProcessError as e:
+        os.unlink(tmp)
+        raise RuntimeError(f"native build failed: {e.stderr}") from e
+    os.replace(tmp, _SO)
+    _log.info("built native map library: %s", _SO)
+    return _SO
+
+
+class NativeMapper:
+    """ctypes wrapper exposing n-gram counting as MapOutput."""
+
+    def __init__(self, so_path: str):
+        self._lib = ctypes.CDLL(so_path)
+        self._lib.moxt_map_ngram.restype = ctypes.POINTER(_MapResult)
+        self._lib.moxt_map_ngram.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32,
+        ]
+        self._lib.moxt_free_result.restype = None
+        self._lib.moxt_free_result.argtypes = [ctypes.POINTER(_MapResult)]
+
+    def map_ngram(self, chunk: bytes, n: int) -> MapOutput:
+        rp = self._lib.moxt_map_ngram(chunk, len(chunk), n)
+        try:
+            r = rp.contents
+            if r.error == 1:
+                raise ValueError("64-bit hash collision in native map")
+            if r.error:
+                raise RuntimeError(f"native map error {r.error}")
+            nu = r.n_unique
+            if nu == 0:
+                hashes = np.empty(0, np.uint64)
+                counts = np.empty(0, np.int32)
+                d = HashDictionary()
+            else:
+                hashes = np.ctypeslib.as_array(r.hashes, (nu,)).copy()
+                counts = np.ctypeslib.as_array(r.counts, (nu,)).copy()
+                offs = np.ctypeslib.as_array(r.tok_off, (nu + 1,))
+                blob = bytes(
+                    np.ctypeslib.as_array(r.tok_bytes, (int(offs[nu]),))
+                )
+                d = HashDictionary()
+                ol = offs.tolist()
+                hl = hashes.tolist()
+                for i in range(nu):
+                    d.add(hl[i], blob[ol[i]:ol[i + 1]])
+            records = max(int(r.n_tokens) - (n - 1), 0) if r.n_tokens else 0
+            hi, lo = split_u64(hashes)
+            return MapOutput(hi=hi, lo=lo, values=counts, dictionary=d,
+                             records_in=records)
+        finally:
+            self._lib.moxt_free_result(rp)
+
+    def map_wordcount(self, chunk: bytes) -> MapOutput:
+        return self.map_ngram(chunk, 1)
+
+    def map_bigram(self, chunk: bytes) -> MapOutput:
+        return self.map_ngram(chunk, 2)
+
+
+def load_native() -> NativeMapper:
+    return NativeMapper(_compile())

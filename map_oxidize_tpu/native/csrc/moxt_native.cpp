@@ -1,0 +1,268 @@
+// Native map hot loop: tokenize + hash + in-chunk combine in one pass.
+//
+// This is the TPU-native framework's equivalent of the reference's compiled
+// map path (the Rust `count_words`, /root/reference/src/main.rs:94-101, which
+// allocates a lowercased String per token and upserts a std HashMap).  Here
+// one scan over the chunk does ASCII-whitespace splitting, ASCII lowercasing,
+// FNV-1a 64-bit hashing and open-addressed counting, GIL-free (called via
+// ctypes).  Output is columnar — (hash, count) arrays plus a token-bytes
+// arena — ready for zero-copy hand-off to the device engine.
+//
+// Semantics contract (tests enforce bit-identity with the Python fallback):
+//   * token boundaries == Python bytes.split(): runs of {' ','\t','\n','\r',
+//     '\v','\f'} separate tokens, no empty tokens;
+//   * lowercase == Python bytes.lower(): only bytes 'A'..'Z' change;
+//   * hash == ops/hashing.py fnv1a64_bytes (FNV-1a 64);
+//   * n-gram keys (n>=2) are tokens joined by a single ' ' (workloads/
+//     bigram.py), hashed over the joined bytes;
+//   * equal 64-bit hashes with different token bytes abort with error=1 —
+//     the same collision guarantee HashDictionary.add gives.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+constexpr uint64_t kFnvOffset = 0xCBF29CE484222325ULL;
+constexpr uint64_t kFnvPrime = 0x100000001B3ULL;
+
+inline bool is_ascii_space(uint8_t c) {
+  return c == ' ' || c == '\t' || c == '\n' || c == '\r' || c == '\v' ||
+         c == '\f';
+}
+
+inline uint8_t ascii_lower(uint8_t c) {
+  return (c >= 'A' && c <= 'Z') ? c + 32 : c;
+}
+
+// Growable byte arena for unique-token storage.
+struct Arena {
+  uint8_t* data = nullptr;
+  int64_t size = 0;
+  int64_t cap = 0;
+
+  int64_t append(const uint8_t* p, int64_t n) {
+    if (size + n > cap) {
+      int64_t nc = cap ? cap * 2 : 1 << 16;
+      while (nc < size + n) nc *= 2;
+      data = static_cast<uint8_t*>(realloc(data, nc));
+      cap = nc;
+    }
+    memcpy(data + size, p, n);
+    int64_t at = size;
+    size += n;
+    return at;
+  }
+};
+
+// Open-addressed (hash -> count, token) table, power-of-two capacity.
+struct Table {
+  uint64_t* hashes = nullptr;
+  int32_t* counts = nullptr;
+  int64_t* tok_at = nullptr;   // arena offset of the stored token
+  int32_t* tok_len = nullptr;
+  uint8_t* used = nullptr;
+  int64_t cap = 0;
+  int64_t n = 0;
+
+  void init(int64_t c) {
+    cap = c;
+    hashes = static_cast<uint64_t*>(malloc(c * sizeof(uint64_t)));
+    counts = static_cast<int32_t*>(malloc(c * sizeof(int32_t)));
+    tok_at = static_cast<int64_t*>(malloc(c * sizeof(int64_t)));
+    tok_len = static_cast<int32_t*>(malloc(c * sizeof(int32_t)));
+    used = static_cast<uint8_t*>(calloc(c, 1));
+    n = 0;
+  }
+  void destroy() {
+    free(hashes); free(counts); free(tok_at); free(tok_len); free(used);
+  }
+
+  void grow() {
+    Table bigger;
+    bigger.init(cap * 2);
+    for (int64_t i = 0; i < cap; i++) {
+      if (!used[i]) continue;
+      int64_t j = hashes[i] & (bigger.cap - 1);
+      while (bigger.used[j]) j = (j + 1) & (bigger.cap - 1);
+      bigger.used[j] = 1;
+      bigger.hashes[j] = hashes[i];
+      bigger.counts[j] = counts[i];
+      bigger.tok_at[j] = tok_at[i];
+      bigger.tok_len[j] = tok_len[i];
+    }
+    bigger.n = n;
+    destroy();
+    *this = bigger;
+  }
+
+  // Returns false on a 64-bit hash collision (same hash, different bytes).
+  bool upsert(uint64_t h, const uint8_t* tok, int32_t len, Arena& arena) {
+    if (n * 3 >= cap * 2) grow();  // load factor 2/3
+    int64_t i = h & (cap - 1);
+    while (used[i]) {
+      if (hashes[i] == h) {
+        if (tok_len[i] != len ||
+            memcmp(arena.data + tok_at[i], tok, len) != 0) {
+          return false;  // collision: caller aborts, Python path raises too
+        }
+        counts[i]++;
+        return true;
+      }
+      i = (i + 1) & (cap - 1);
+    }
+    used[i] = 1;
+    hashes[i] = h;
+    counts[i] = 1;
+    tok_at[i] = arena.append(tok, len);
+    tok_len[i] = len;
+    n++;
+    return true;
+  }
+};
+
+inline uint64_t fnv1a(const uint8_t* p, int64_t n, uint64_t h = kFnvOffset) {
+  for (int64_t i = 0; i < n; i++) {
+    h ^= p[i];
+    h *= kFnvPrime;
+  }
+  return h;
+}
+
+}  // namespace
+
+extern "C" {
+
+struct MapResult {
+  uint64_t* hashes;    // [n_unique]
+  int32_t* counts;     // [n_unique]
+  int64_t* tok_off;    // [n_unique + 1] offsets into tok_bytes
+  uint8_t* tok_bytes;  // concatenated (lowercased) unique key bytes
+  int64_t n_unique;
+  int64_t n_tokens;    // total tokens scanned in the chunk
+  int32_t error;       // 0 ok; 1 = 64-bit hash collision
+};
+
+// Count n-grams (n=1: word count; n=2: bigrams; ...) over one chunk.
+// Keys are lowercased tokens joined by ' '.  Caller owns the result via
+// moxt_free_result.
+MapResult* moxt_map_ngram(const uint8_t* data, int64_t len, int32_t ngram) {
+  MapResult* r = static_cast<MapResult*>(calloc(1, sizeof(MapResult)));
+  if (ngram < 1) { r->error = 2; return r; }
+
+  Arena arena;          // unique-key storage
+  Table table;
+  table.init(1 << 16);
+
+  // scratch: the current joined n-gram key (lowercased)
+  int64_t scratch_cap = 1 << 12;
+  uint8_t* scratch = static_cast<uint8_t*>(malloc(scratch_cap));
+  // ring buffer of the last `ngram` token (start, len) pairs in scratch2
+  // — simpler: keep last-(n-1) joined suffix by re-membering token spans.
+  // We store the last n token copies in a small arena that we rebuild.
+  struct Span { int64_t at; int32_t len; };
+  Span* ring = static_cast<Span*>(malloc(ngram * sizeof(Span)));
+  int32_t filled = 0;
+  Arena toks;  // holds lowercased recent tokens (monotone; compacted rarely)
+
+  int64_t n_tokens = 0;
+  int64_t i = 0;
+  bool ok = true;
+  while (i < len && ok) {
+    while (i < len && is_ascii_space(data[i])) i++;
+    if (i >= len) break;
+    int64_t start = i;
+    while (i < len && !is_ascii_space(data[i])) i++;
+    int32_t tlen = static_cast<int32_t>(i - start);
+
+    // lowercase the token into the token arena
+    if (toks.size > (64 << 20)) {
+      // compact: keep only the live ring spans
+      Arena fresh;
+      for (int32_t k = 0; k < filled; k++) {
+        int64_t at = fresh.append(toks.data + ring[k].at, ring[k].len);
+        ring[k].at = at;
+      }
+      free(toks.data);
+      toks = fresh;
+    }
+    int64_t at = toks.append(reinterpret_cast<const uint8_t*>(data + start),
+                             tlen);
+    for (int64_t k = at; k < at + tlen; k++)
+      toks.data[k] = ascii_lower(toks.data[k]);
+
+    // slide the ring
+    if (filled == ngram) {
+      memmove(ring, ring + 1, (ngram - 1) * sizeof(Span));
+      filled--;
+    }
+    ring[filled].at = at;
+    ring[filled].len = tlen;
+    filled++;
+    n_tokens++;
+
+    if (filled == ngram) {
+      // build the joined key in scratch
+      int64_t klen = 0;
+      for (int32_t k = 0; k < ngram; k++) klen += ring[k].len + (k ? 1 : 0);
+      if (klen > scratch_cap) {
+        while (scratch_cap < klen) scratch_cap *= 2;
+        scratch = static_cast<uint8_t*>(realloc(scratch, scratch_cap));
+      }
+      int64_t w = 0;
+      for (int32_t k = 0; k < ngram; k++) {
+        if (k) scratch[w++] = ' ';
+        memcpy(scratch + w, toks.data + ring[k].at, ring[k].len);
+        w += ring[k].len;
+      }
+      uint64_t h = fnv1a(scratch, klen);
+      ok = table.upsert(h, scratch, static_cast<int32_t>(klen), arena);
+    }
+  }
+
+  if (!ok) {
+    r->error = 1;
+  } else {
+    // compact the table into columnar output
+    r->n_unique = table.n;
+    r->n_tokens = n_tokens;
+    r->hashes = static_cast<uint64_t*>(malloc(table.n * sizeof(uint64_t)));
+    r->counts = static_cast<int32_t*>(malloc(table.n * sizeof(int32_t)));
+    r->tok_off = static_cast<int64_t*>(malloc((table.n + 1) * sizeof(int64_t)));
+    int64_t total_tok = 0;
+    for (int64_t t = 0; t < table.cap; t++)
+      if (table.used[t]) total_tok += table.tok_len[t];
+    r->tok_bytes = static_cast<uint8_t*>(malloc(total_tok ? total_tok : 1));
+    int64_t out = 0, off = 0;
+    for (int64_t t = 0; t < table.cap; t++) {
+      if (!table.used[t]) continue;
+      r->hashes[out] = table.hashes[t];
+      r->counts[out] = table.counts[t];
+      r->tok_off[out] = off;
+      memcpy(r->tok_bytes + off, arena.data + table.tok_at[t],
+             table.tok_len[t]);
+      off += table.tok_len[t];
+      out++;
+    }
+    r->tok_off[out] = off;
+  }
+
+  free(scratch);
+  free(ring);
+  free(toks.data);
+  free(arena.data);
+  table.destroy();
+  return r;
+}
+
+void moxt_free_result(MapResult* r) {
+  if (!r) return;
+  free(r->hashes);
+  free(r->counts);
+  free(r->tok_off);
+  free(r->tok_bytes);
+  free(r);
+}
+
+}  // extern "C"

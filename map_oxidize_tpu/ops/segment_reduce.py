@@ -119,19 +119,21 @@ def make_accumulator(capacity: int, val_shape=(), val_dtype=jnp.int32, combine="
     return hi, lo, vals
 
 
-@partial(jax.jit, static_argnames=("combine",), donate_argnums=(0, 1, 2))
-def merge_into_accumulator(acc_hi, acc_lo, acc_vals, b_hi, b_lo, b_vals, combine="sum"):
+@partial(jax.jit, static_argnames=("combine",), donate_argnums=(0, 1, 2, 3))
+def merge_into_accumulator(acc_hi, acc_lo, acc_vals, ovf, b_hi, b_lo, b_vals,
+                           combine="sum"):
     """Fold one mapped batch into the running accumulator.
 
     Concatenate accumulator (capacity C) with batch (size B), reduce, keep the
-    first C rows.  Correct as long as the true number of distinct keys fits in
-    C; the returned ``n_unique`` lets the engine detect overflow (a value
-    > C - safety-margin means capacity must grow).  Buffers are donated so the
-    accumulator is updated in place in HBM.
+    first C rows.  ``ovf`` is a cumulative dropped-key counter carried through
+    every merge: keys truncated past C add to it, so a later clean merge can
+    never shadow an earlier loss and an *exactly full* accumulator is not an
+    error.  Buffers are donated so the accumulator updates in place in HBM.
     """
     cap = acc_hi.shape[0]
     hi = jnp.concatenate([acc_hi, b_hi])
     lo = jnp.concatenate([acc_lo, b_lo])
     vals = jnp.concatenate([acc_vals, b_vals])
     u_hi, u_lo, u_vals, n_unique = reduce_pairs(hi, lo, vals, combine)
-    return u_hi[:cap], u_lo[:cap], u_vals[:cap], n_unique
+    ovf = ovf + jnp.maximum(n_unique - cap, 0)
+    return u_hi[:cap], u_lo[:cap], u_vals[:cap], n_unique, ovf

@@ -26,7 +26,7 @@ from map_oxidize_tpu.config import JobConfig
 from map_oxidize_tpu.ops.segment_reduce import make_accumulator
 from map_oxidize_tpu.parallel.mesh import SHARD_AXIS, make_mesh, sharded
 from map_oxidize_tpu.parallel.shuffle import build_sharded_ops
-from map_oxidize_tpu.runtime.engine import CapacityError, StreamingEngineBase
+from map_oxidize_tpu.runtime.engine import StreamingEngineBase
 from map_oxidize_tpu.utils.logging import get_logger
 
 _log = get_logger(__name__)
@@ -58,47 +58,62 @@ class ShardedReduceEngine(StreamingEngineBase):
         self.S = self.mesh.shape[SHARD_AXIS]
         # per-shard sizes; global arrays are S x these
         self.batch_per_shard = max(1, config.batch_size // self.S)
-        self.cap_per_shard = max(1, config.key_capacity // self.S)
+        self.max_capacity = max(1, config.key_capacity // self.S)
+        self.capacity = min(
+            max(1, -(-config.initial_key_capacity // self.S)),
+            self.max_capacity,
+        )
         self.feed_batch = self.batch_per_shard * self.S
         self._sharding = sharded(self.mesh)
 
-        self._merge, self._topk = build_sharded_ops(
+        self._merge, self._topk, self._grow, self.bucket_cap = build_sharded_ops(
             self.mesh, self.combine, bucket_cap, self.batch_per_shard
         )
         acc = make_accumulator(
-            self.cap_per_shard * self.S, self.value_shape, self.value_dtype,
+            self.capacity * self.S, self.value_shape, self.value_dtype,
             self.combine,
         )
         self._acc = list(jax.device_put(acc, self._sharding))
-        self._n_unique = None   # [S] per-shard unique counts
-        # [S] cumulative overflow counter, threaded through every merge
+        # [S] cumulative dropped-row counter (exchange-bucket drops plus
+        # accumulator truncation), threaded through every merge
         self._overflow = jax.device_put(
             np.zeros(self.S, np.int32), self._sharding
         )
 
+    def _round_batch(self, n: int) -> int:
+        b = super()._round_batch(n)
+        return -(-b // self.S) * self.S  # shard_map needs S | batch rows
+
+    def _incoming(self, batch_rows: int) -> int:
+        # worst-case rows landing on one shard in this merge: every source
+        # shard can fill its bucket for us, but never more than it holds
+        return min(batch_rows, self.S * self.bucket_cap)
+
+    def _read_live(self) -> int:
+        return int(np.max(np.asarray(self._n_unique)))  # worst shard
+
+    def _apply_grow(self, new_cap: int) -> None:
+        self._acc = list(self._grow(*self._acc, new_cap - self.capacity))
+
     def _merge_batch(self, padded) -> None:
+        incoming = self._incoming(padded[0].shape[0])
+        self._ensure_capacity(incoming)
         batch = jax.device_put(padded, self._sharding)
         *self._acc, self._n_unique, self._overflow = self._merge(
             *self._acc, self._overflow, *batch
         )
+        self._n_live_ub += incoming
 
     def _check_health(self) -> None:
-        ovf = int(np.asarray(self._overflow)[0])  # host sync
-        if ovf:
+        dropped = int(np.asarray(self._overflow)[0])  # host sync
+        if dropped:
             raise ShuffleOverflowError(
-                f"{ovf} rows overflowed the all_to_all bucket capacity; "
-                "increase bucket_cap"
+                f"{dropped} rows dropped (bucket overflow or a shard "
+                f"accumulator past key_capacity); increase bucket_cap / "
+                "key_capacity"
             )
-        if self._n_unique is not None:
-            worst = int(np.max(np.asarray(self._n_unique)))
-            if worst >= self.cap_per_shard:
-                raise CapacityError(
-                    f"a shard accumulator filled: {worst} unique keys >= "
-                    f"per-shard capacity {self.cap_per_shard}; increase "
-                    "key_capacity"
-                )
 
-    def finalize(self):
+    def _finalize(self):
         self._check_health()
         if self._n_unique is None:
             return (*self._acc, 0)

@@ -19,6 +19,10 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from map_oxidize_tpu.utils.logging import get_logger
+
+_log = get_logger(__name__)
+
 SHARD_AXIS = "shards"
 
 
@@ -33,7 +37,15 @@ def make_mesh(num_shards: int = 0, backend: str = "auto") -> Mesh:
         if num_shards > len(devs):
             # the accelerator pool is too small; the CPU platform may carry a
             # larger virtual pool (--xla_force_host_platform_device_count)
-            devs = jax.devices("cpu")
+            cpus = jax.devices("cpu")
+            if len(cpus) >= num_shards:
+                _log.warning(
+                    "auto backend: %d shards exceed the %d-device default "
+                    "pool (%s); falling back to %d virtual CPU devices",
+                    num_shards, len(devs),
+                    devs[0].platform if devs else "none", len(cpus),
+                )
+            devs = cpus
     else:
         devs = [d for d in jax.devices() if d.platform == backend]
         if not devs and backend == "cpu":

@@ -118,13 +118,16 @@ def _merge_step(acc_hi, acc_lo, acc_vals, ovf_in, b_hi, b_lo, b_vals,
     lo = jnp.concatenate([acc_lo, r_lo])
     vals = jnp.concatenate([acc_vals, r_vals])
     u_hi, u_lo, u_vals, n_unique = reduce_pairs(hi, lo, vals, combine)
+    # cumulative dropped-row counter: exchange-bucket drops (replicated psum)
+    # plus this shard's accumulator truncation (psum'd so the counter stays
+    # identical on every shard and the out_spec uniform)
+    acc_drop = lax.psum(jnp.maximum(n_unique - C, 0), SHARD_AXIS)
     return (
         u_hi[:C],
         u_lo[:C],
         u_vals[:C],
         n_unique.reshape(1),            # per-shard unique count -> [S] global
-        ovf_in + overflow.reshape(1),   # cumulative; replicated value carried
-                                        # per-shard so the out_spec is uniform
+        ovf_in + overflow.reshape(1) + acc_drop.reshape(1),
     )
 
 
@@ -196,10 +199,30 @@ def build_sharded_ops(mesh, combine: str = "sum", bucket_cap: int = 0,
         )
         return jax.jit(f)
 
+    def grow_fn(acc_hi, acc_lo, acc_vals, pad_per_shard: int):
+        """Grow each shard's accumulator by ``pad_per_shard`` SENTINEL rows.
+        Growth is per-shard concatenation — a global concat would append all
+        padding after shard S-1's block instead of after each shard's."""
+        from map_oxidize_tpu.ops.segment_reduce import make_accumulator
+
+        def _grow(h, l, v):
+            p_h, p_l, p_v = make_accumulator(
+                pad_per_shard, v.shape[1:], v.dtype, combine
+            )
+            return (
+                jnp.concatenate([h, p_h]),
+                jnp.concatenate([l, p_l]),
+                jnp.concatenate([v, p_v]),
+            )
+
+        f = jax.shard_map(_grow, mesh=mesh, in_specs=(spec,) * 3,
+                          out_specs=(spec,) * 3)
+        return jax.jit(f, donate_argnums=(0, 1, 2))(acc_hi, acc_lo, acc_vals)
+
     def topk_fn(acc_hi, acc_lo, acc_vals, k: int):
         cap_per_shard = acc_hi.shape[0] // S
         k_local = min(k, cap_per_shard)
         k_final = min(k, S * k_local)
         return _topk_compiled(k_local, k_final)(acc_hi, acc_lo, acc_vals)
 
-    return merge, topk_fn
+    return merge, topk_fn, grow_fn, bucket_cap

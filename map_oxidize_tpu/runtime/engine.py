@@ -36,6 +36,7 @@ import abc
 
 import numpy as np
 import jax
+import jax.numpy as jnp
 
 from map_oxidize_tpu.api import MapOutput, Reducer
 from map_oxidize_tpu.config import JobConfig
@@ -52,8 +53,8 @@ _log = get_logger(__name__)
 
 
 class CapacityError(RuntimeError):
-    """Distinct keys exceeded (or filled) the accumulator capacity; re-run
-    with a larger ``key_capacity``."""
+    """Keys were dropped: distinct keys exceeded the accumulator's maximum
+    capacity; re-run with a larger ``key_capacity``."""
 
 
 def pick_device(backend: str = "auto"):
@@ -70,13 +71,37 @@ def pick_device(backend: str = "auto"):
                        f"{[d.platform for d in jax.devices()]}")
 
 
-class StreamingEngineBase(abc.ABC):
-    """Shared host-side surface: fixed-shape batch padding, the feed loop,
-    and the health-check cadence.  Subclasses own the device state and the
-    merge executable."""
+def next_pow2(n: int) -> int:
+    return 1 << max(n - 1, 1).bit_length()
 
-    #: rows per padded device batch; set by subclass __init__
+
+class StreamingEngineBase(abc.ABC):
+    """Shared host-side surface: batch padding, the feed loop, and the
+    health-check cadence.  Subclasses own the device state and the merge
+    executable.
+
+    Batch sizing: rows are fed in slices of at most ``feed_batch``, each
+    padded up to the next power of two (subclasses may round further via
+    ``_round_batch``).  A handful of distinct shapes keeps XLA's executable
+    cache small while short chunks avoid full-batch sort cost — a mapper
+    emitting 30k combined rows must not pay for a 1M-row merge.
+
+    Capacity growth: the accumulator starts at ``initial_key_capacity`` and
+    grows by 4x sentinel-pad steps toward ``key_capacity`` (the hard max).
+    Growth happens *before* a merge could overflow, driven by a host-tracked
+    upper bound on live keys (+= batch rows per merge, no device sync); the
+    bound is refreshed from the device's exact count only when it would
+    otherwise force a growth, so syncs stay rare and the feed path async.
+    Past ``key_capacity``, merges drop keys — counted by a cumulative
+    device-side counter that the health check turns into ``CapacityError``
+    (an exactly-full accumulator is NOT an error; only actual drops are).
+    """
+
+    #: max rows per padded device batch; set by subclass __init__
     feed_batch: int
+    #: current / maximum accumulator capacity (per shard, where sharded)
+    capacity: int
+    max_capacity: int
 
     def __init__(
         self,
@@ -94,11 +119,21 @@ class StreamingEngineBase(abc.ABC):
         self._merges = 0
         self._check_every = overflow_check_every
         self.rows_fed = 0
+        self._stage: list = []   # host-side staging of mapped rows
+        self._staged = 0
+        self._n_unique = None    # device-side live-key count (per last merge)
+        self._n_live_ub = 0      # host upper bound on live keys
+
+    def _round_batch(self, n: int) -> int:
+        """Padded size for an ``n``-row slice: next power of two, capped at
+        ``feed_batch``.  Subclasses may round further (e.g. to a multiple of
+        the shard count)."""
+        return min(next_pow2(max(n, 512)), self.feed_batch)
 
     def _pad(self, hi, lo, vals, start, stop):
         """Copy rows [start:stop) into fresh SENTINEL/identity-padded arrays
-        of the fixed feed-batch shape."""
-        b = self.feed_batch
+        of the rounded batch shape."""
+        b = self._round_batch(stop - start)
         n = stop - start
         p_hi = np.full(b, SENTINEL, np.uint32)
         p_lo = np.full(b, SENTINEL, np.uint32)
@@ -109,15 +144,73 @@ class StreamingEngineBase(abc.ABC):
         return p_hi, p_lo, p_vals
 
     def feed(self, out: MapOutput) -> None:
-        """Fold one mapped chunk into the accumulator (async dispatch)."""
+        """Stage one mapped chunk; flush to device when enough rows gather.
+
+        Host->device transfer has a large fixed per-call latency (hundreds of
+        ms through a remote-attach tunnel), so mapped chunks are concatenated
+        host-side and shipped in feed_batch-sized slices rather than one
+        device_put per chunk — cutting round trips by the chunks-per-batch
+        factor.  numpy concatenation at these sizes is microseconds.
+        """
         rows = len(out)
         self.rows_fed += rows
-        for start in range(0, max(rows, 0), self.feed_batch):
-            stop = min(start + self.feed_batch, rows)
-            self._merge_batch(self._pad(out.hi, out.lo, out.values, start, stop))
+        if rows == 0:
+            return
+        self._stage.append((out.hi, out.lo, out.values))
+        self._staged += rows
+        if self._staged >= self.feed_batch:
+            self.flush()
+
+    def flush(self) -> None:
+        """Ship all staged rows to the device."""
+        if not self._staged:
+            return
+        if len(self._stage) == 1:
+            hi, lo, vals = self._stage[0]
+        else:
+            hi = np.concatenate([s[0] for s in self._stage])
+            lo = np.concatenate([s[1] for s in self._stage])
+            vals = np.concatenate([s[2] for s in self._stage])
+        self._stage = []
+        self._staged = 0
+        for start in range(0, hi.shape[0], self.feed_batch):
+            stop = min(start + self.feed_batch, hi.shape[0])
+            self._merge_batch(self._pad(hi, lo, vals, start, stop))
             self._merges += 1
             if self._merges % self._check_every == 0:
                 self._check_health()
+
+    # --- capacity growth (shared; subclasses provide the two hooks) -------
+
+    def _incoming(self, batch_rows: int) -> int:
+        """Upper bound on new live keys one padded batch can add."""
+        return batch_rows
+
+    def _ensure_capacity(self, incoming: int) -> None:
+        if self.capacity >= self.max_capacity:
+            return
+        if self._n_live_ub + incoming > self.capacity and self._n_unique is not None:
+            # the bound would force growth — refresh it from the device first
+            # (the only sync on the feed path, and only near a growth edge)
+            self._n_live_ub = self._read_live()
+        needed = self._n_live_ub + incoming
+        if needed <= self.capacity:
+            return
+        new_cap = self.capacity
+        while new_cap < needed and new_cap < self.max_capacity:
+            new_cap *= 4
+        new_cap = min(new_cap, self.max_capacity)
+        self._apply_grow(new_cap)
+        _log.info("accumulator grown %d -> %d rows", self.capacity, new_cap)
+        self.capacity = new_cap
+
+    @abc.abstractmethod
+    def _read_live(self) -> int:
+        """Exact live-key count from the device (sync point)."""
+
+    @abc.abstractmethod
+    def _apply_grow(self, new_cap: int) -> None:
+        """Extend the device accumulator with SENTINEL rows to ``new_cap``."""
 
     @abc.abstractmethod
     def _merge_batch(self, padded) -> None:
@@ -125,12 +218,18 @@ class StreamingEngineBase(abc.ABC):
 
     @abc.abstractmethod
     def _check_health(self) -> None:
-        """Raise if keys were dropped or capacity filled (host sync point)."""
+        """Raise if keys were dropped (host sync point)."""
+
+    def finalize(self):
+        """Flush staged rows, block + health-check; return
+        ``(hi, lo, vals, n_unique)`` per the engine contract (SENTINEL rows
+        are padding — mask, don't slice)."""
+        self.flush()
+        return self._finalize()
 
     @abc.abstractmethod
-    def finalize(self):
-        """Block + health-check; return ``(hi, lo, vals, n_unique)`` per the
-        engine contract (SENTINEL rows are padding — mask, don't slice)."""
+    def _finalize(self):
+        """Post-flush finalize; see :meth:`finalize`."""
 
     @abc.abstractmethod
     def _top_k_device(self, k: int):
@@ -168,32 +267,49 @@ class DeviceReduceEngine(StreamingEngineBase):
                          overflow_check_every)
         self.device = device if device is not None else pick_device(config.backend)
         self.feed_batch = config.batch_size
-        self.capacity = config.key_capacity
+        self.max_capacity = config.key_capacity
+        self.capacity = min(config.initial_key_capacity, self.max_capacity)
         self._acc = list(jax.device_put(
             make_accumulator(
                 self.capacity, self.value_shape, self.value_dtype, self.combine
             ),
             self.device,
         ))
-        self._n_unique = None
+        self._ovf = jax.device_put(np.zeros((), np.int32), self.device)
+
+    def _read_live(self) -> int:
+        return int(self._n_unique)
+
+    def _apply_grow(self, new_cap: int) -> None:
+        pad = new_cap - self.capacity
+        hi, lo, vals = self._acc
+        p_hi, p_lo, p_vals = make_accumulator(
+            pad, self.value_shape, self.value_dtype, self.combine
+        )
+        self._acc = [
+            jnp.concatenate([hi, jax.device_put(p_hi, self.device)]),
+            jnp.concatenate([lo, jax.device_put(p_lo, self.device)]),
+            jnp.concatenate([vals, jax.device_put(p_vals, self.device)]),
+        ]
 
     def _merge_batch(self, padded) -> None:
+        incoming = self._incoming(padded[0].shape[0])
+        self._ensure_capacity(incoming)
         batch = jax.device_put(padded, self.device)
-        *self._acc, self._n_unique = merge_into_accumulator(
-            *self._acc, *batch, combine=self.combine
+        *self._acc, self._n_unique, self._ovf = merge_into_accumulator(
+            *self._acc, self._ovf, *batch, combine=self.combine
         )
+        self._n_live_ub += incoming
 
     def _check_health(self) -> None:
-        if self._n_unique is None:
-            return
-        n = int(self._n_unique)  # host sync point
-        if n >= self.capacity:
+        dropped = int(self._ovf)  # host sync point
+        if dropped:
             raise CapacityError(
-                f"accumulator filled: {n} unique keys >= capacity "
-                f"{self.capacity}; increase key_capacity"
+                f"{dropped} distinct keys dropped: accumulator exceeded "
+                f"key_capacity={self.max_capacity}; increase key_capacity"
             )
 
-    def finalize(self):
+    def _finalize(self):
         self._check_health()
         n = 0 if self._n_unique is None else int(self._n_unique)
         return (*self._acc, n)

@@ -28,10 +28,17 @@ class BigramMapper(Mapper):
     value_shape = ()
     value_dtype = np.int32
 
-    def __init__(self, tokenizer: str = "ascii"):
+    def __init__(self, tokenizer: str = "ascii", use_native: bool = True):
         self.tokenizer = tokenizer
+        self._native = None
+        if use_native and tokenizer == "ascii":
+            from map_oxidize_tpu.native import bindings
+
+            self._native = bindings.load_or_none()
 
     def map_chunk(self, chunk: bytes) -> MapOutput:
+        if self._native is not None:
+            return self._native.map_bigram(chunk)
         toks = tokenize(chunk, self.tokenizer)
         pairs = Counter(
             toks[i] + b" " + toks[i + 1] for i in range(len(toks) - 1)
@@ -49,5 +56,5 @@ class BigramMapper(Mapper):
                          records_in=max(len(toks) - 1, 0))
 
 
-def make_bigram(tokenizer: str = "ascii"):
-    return BigramMapper(tokenizer), SumReducer()
+def make_bigram(tokenizer: str = "ascii", use_native: bool = True):
+    return BigramMapper(tokenizer, use_native), SumReducer()

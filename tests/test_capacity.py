@@ -1,0 +1,89 @@
+"""Accumulator capacity growth + overflow semantics (both engines).
+
+Review-derived regressions: an exactly-full accumulator must NOT raise;
+growth must kick in below key_capacity; actual drops past key_capacity must
+raise; initial_key_capacity=0 must be rejected at config validation.
+"""
+
+import numpy as np
+import pytest
+
+from map_oxidize_tpu.api import MapOutput, SumReducer
+from map_oxidize_tpu.config import JobConfig
+from map_oxidize_tpu.ops.hashing import HashDictionary, join_u64, SENTINEL
+from map_oxidize_tpu.parallel import ShardedReduceEngine, ShuffleOverflowError
+from map_oxidize_tpu.runtime.engine import CapacityError, DeviceReduceEngine
+
+
+def _out(keys, vals=None):
+    keys = np.asarray(keys, np.uint64)
+    if vals is None:
+        vals = np.ones(len(keys), np.int32)
+    hi = (keys >> np.uint64(32)).astype(np.uint32)
+    lo = (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    return MapOutput(hi=hi, lo=lo, values=vals, dictionary=HashDictionary())
+
+
+def _live(engine):
+    hi, lo, vals, n = engine.finalize()
+    hi, lo, vals = np.asarray(hi), np.asarray(lo), np.asarray(vals)
+    m = ~((hi == np.uint32(SENTINEL)) & (lo == np.uint32(SENTINEL)))
+    return dict(zip(join_u64(hi[m], lo[m]).tolist(), vals[m].tolist())), n
+
+
+def test_exact_fill_is_not_an_error():
+    """512 distinct keys into capacity exactly 512 must succeed."""
+    cfg = JobConfig(backend="cpu", batch_size=512, key_capacity=512,
+                    initial_key_capacity=512)
+    eng = DeviceReduceEngine(cfg, SumReducer())
+    eng.feed(_out(np.arange(512)))
+    got, n = _live(eng)
+    assert n == 512 and len(got) == 512
+
+
+def test_growth_below_max():
+    """Distinct keys 16x the initial capacity must grow, not raise."""
+    cfg = JobConfig(backend="cpu", batch_size=512, key_capacity=8192,
+                    initial_key_capacity=512)
+    eng = DeviceReduceEngine(cfg, SumReducer())
+    for start in range(0, 8192, 512):
+        eng.feed(_out(np.arange(start, start + 512)))
+    got, n = _live(eng)
+    assert n == 8192
+    assert eng.capacity >= 8192
+    assert all(v == 1 for v in got.values())
+
+
+def test_drop_past_max_raises():
+    cfg = JobConfig(backend="cpu", batch_size=512, key_capacity=256,
+                    initial_key_capacity=256)
+    eng = DeviceReduceEngine(cfg, SumReducer())
+    eng.feed(_out(np.arange(512)))
+    with pytest.raises(CapacityError):
+        eng.finalize()
+
+
+def test_sharded_growth_below_max(rng):
+    cfg = JobConfig(backend="cpu", batch_size=512, key_capacity=1 << 14,
+                    initial_key_capacity=64, num_shards=8)
+    eng = ShardedReduceEngine(cfg, SumReducer())
+    keys = rng.permutation(6000).astype(np.uint64)
+    for s in range(0, 6000, 500):
+        eng.feed(_out(keys[s:s + 500]))
+    got, n = _live(eng)
+    assert n == 6000
+    assert all(v == 1 for v in got.values())
+
+
+def test_sharded_drop_past_max_raises(rng):
+    cfg = JobConfig(backend="cpu", batch_size=512, key_capacity=64,
+                    initial_key_capacity=64, num_shards=8)
+    eng = ShardedReduceEngine(cfg, SumReducer())
+    eng.feed(_out(np.arange(2000)))
+    with pytest.raises(ShuffleOverflowError):
+        eng.finalize()
+
+
+def test_zero_initial_capacity_rejected():
+    with pytest.raises(ValueError):
+        JobConfig(initial_key_capacity=0).validate()

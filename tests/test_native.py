@@ -1,0 +1,83 @@
+"""Native (C++) map path: bit-identity with the Python fallback.
+
+The contract (native/csrc/moxt_native.cpp header comment): same token
+boundaries as bytes.split(), same lowercasing as bytes.lower(), same FNV-1a64
+as ops/hashing.py, same n-gram join as workloads/bigram.py.  Every test
+compares full (hash -> count) dicts and dictionaries, not just top-k.
+"""
+
+import numpy as np
+import pytest
+
+from map_oxidize_tpu.native.bindings import load_or_none
+from map_oxidize_tpu.ops.hashing import join_u64
+from map_oxidize_tpu.workloads.bigram import BigramMapper
+from map_oxidize_tpu.workloads.wordcount import WordCountMapper
+
+native = load_or_none()
+pytestmark = pytest.mark.skipif(native is None, reason="native build unavailable")
+
+
+def _as_dict(out):
+    k = join_u64(out.hi, out.lo)
+    return dict(zip(k.tolist(), out.values.tolist()))
+
+
+def _dict_bytes(out):
+    return dict(out.dictionary.items())
+
+
+CASES = [
+    b"",
+    b"   \t\n  ",
+    b"hello",
+    b"The quick Brown fox JUMPS over the lazy dog, the the THE",
+    b"a b c d e f g h a b c a b a",
+    b"tabs\tand\nnewlines\rand\x0bvertical\x0cfeeds mixed  double  spaces",
+    b"punct, stays! attached. to? words; always: (parens) [too]",
+    b"x" * 10000 + b" " + b"y" * 3 + b" end",
+    "unicode café naïve 中文 words".encode("utf-8"),
+    b"trailing space ",
+    b" leading",
+    b"A" * 4096,
+]
+
+
+@pytest.mark.parametrize("case", CASES, ids=range(len(CASES)))
+def test_wordcount_native_matches_python(case):
+    py = WordCountMapper("ascii", use_native=False).map_chunk(case)
+    nat = native.map_wordcount(case)
+    assert _as_dict(nat) == _as_dict(py)
+    assert _dict_bytes(nat) == _dict_bytes(py)
+    assert nat.records_in == py.records_in
+
+
+@pytest.mark.parametrize("case", CASES, ids=range(len(CASES)))
+def test_bigram_native_matches_python(case):
+    py = BigramMapper("ascii", use_native=False).map_chunk(case)
+    nat = native.map_bigram(case)
+    assert _as_dict(nat) == _as_dict(py)
+    assert _dict_bytes(nat) == _dict_bytes(py)
+    assert nat.records_in == py.records_in
+
+
+def test_large_random_corpus_identical(rng):
+    words = [bytes(rng.choice(list(b"abcXYZ,."), size=rng.integers(1, 12)))
+             for _ in range(500)]
+    chunk = b" ".join(words[i] for i in rng.integers(0, 500, size=50_000))
+    py = WordCountMapper("ascii", use_native=False).map_chunk(chunk)
+    nat = native.map_wordcount(chunk)
+    assert _as_dict(nat) == _as_dict(py)
+    assert _dict_bytes(nat) == _dict_bytes(py)
+    assert nat.records_in == py.records_in == 50_000
+    # many uniques -> exercises table growth
+    assert len(_as_dict(nat)) > 400
+
+
+def test_trigram_sanity():
+    out = native.map_ngram(b"a b c d", 3)
+    k = join_u64(out.hi, out.lo).tolist()
+    dd = dict(out.dictionary.items())
+    got = {dd[h]: v for h, v in zip(k, out.values.tolist())}
+    assert got == {b"a b c": 1, b"b c d": 1}
+    assert out.records_in == 2

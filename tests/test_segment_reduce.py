@@ -108,19 +108,53 @@ def test_streaming_accumulator_equals_one_shot(rng):
     global reduce (associativity of the monoid)."""
     cap, bs = 2048, 512
     acc = make_accumulator(cap)
+    ovf = jnp.zeros((), jnp.int32)
     all_keys, all_vals = [], []
     for _ in range(10):
         keys64, hi, lo, vals = _random_pairs(rng, bs, 150, with_padding=True)
         all_keys.append(keys64)
         all_vals.append(vals)
-        acc_hi, acc_lo, acc_vals, n_unique = merge_into_accumulator(
-            *acc, jnp.array(hi), jnp.array(lo), jnp.array(vals)
+        acc_hi, acc_lo, acc_vals, n_unique, ovf = merge_into_accumulator(
+            *acc, ovf, jnp.array(hi), jnp.array(lo), jnp.array(vals)
         )
         acc = (acc_hi, acc_lo, acc_vals)
     assert int(n_unique) <= cap
+    assert int(ovf) == 0
     got = _device_result_to_dict(acc_hi, acc_lo, acc_vals, n_unique)
     want = _model_reduce(np.concatenate(all_keys), np.concatenate(all_vals))
     assert got == want
+
+
+def test_merge_overflow_counter(rng):
+    """Truncation past capacity must count dropped keys; exact fill must not."""
+    # exact fill: 64 distinct keys into capacity 64 -> no drop
+    acc = make_accumulator(64)
+    ovf = jnp.zeros((), jnp.int32)
+    keys = np.arange(64, dtype=np.uint64)
+    hi, lo = split_u64(keys)
+    vals = np.ones(64, np.int32)
+    *_, n, ovf = merge_into_accumulator(
+        *acc, ovf, jnp.array(hi), jnp.array(lo), jnp.array(vals)
+    )
+    assert int(n) == 64 and int(ovf) == 0
+    # 100 distinct into capacity 64 -> 36 dropped, and the counter is sticky
+    acc = make_accumulator(64)
+    ovf = jnp.zeros((), jnp.int32)
+    keys = np.arange(100, dtype=np.uint64)
+    hi, lo = split_u64(keys)
+    vals = np.ones(100, np.int32)
+    acc_hi, acc_lo, acc_vals, n, ovf = merge_into_accumulator(
+        *acc, ovf, jnp.array(hi), jnp.array(lo), jnp.array(vals)
+    )
+    assert int(ovf) == 36
+    # a subsequent clean merge must not reset it
+    k2 = np.arange(8, dtype=np.uint64)
+    h2, l2 = split_u64(k2)
+    *_, n, ovf = merge_into_accumulator(
+        acc_hi, acc_lo, acc_vals, ovf,
+        jnp.array(h2), jnp.array(l2), jnp.ones(8, jnp.int32)
+    )
+    assert int(ovf) >= 36
 
 
 def test_top_k_pairs(rng):
