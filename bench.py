@@ -83,9 +83,8 @@ def main() -> int:
         make_corpus(corpus, BENCH_MB)
 
     from map_oxidize_tpu.config import JobConfig
-    from map_oxidize_tpu.runtime.driver import run_wordcount_job
+    from map_oxidize_tpu.runtime import run_job
     from map_oxidize_tpu.workloads.reference_model import top_k_model, wordcount_model
-    from map_oxidize_tpu.workloads.wordcount import make_wordcount
 
     # --- our pipeline (device engine on whatever chip jax offers first)
     cfg = JobConfig(
@@ -95,14 +94,13 @@ def main() -> int:
         top_k=TOP_K,
         metrics=False,
     )
-    mapper, reducer = make_wordcount(cfg.tokenizer, cfg.use_native)
     # warm the XLA cache so compile time isn't billed as throughput
-    run_wordcount_job(
+    run_job(
         JobConfig(input_path=corpus, output_path="", backend="auto",
-                  metrics=False, chunk_bytes=cfg.chunk_bytes), mapper, reducer
+                  metrics=False, chunk_bytes=cfg.chunk_bytes), "wordcount"
     ) if os.environ.get("MOXT_BENCH_WARM", "1") == "1" else None
     t0 = time.perf_counter()
-    result = run_wordcount_job(cfg, mapper, reducer)
+    result = run_job(cfg, "wordcount")
     ours_s = time.perf_counter() - t0
     words = result.metrics["records_in"]
     ours_rate = words / ours_s
@@ -129,7 +127,7 @@ def main() -> int:
         with open(tmp_slice, "wb") as f:
             f.write(slice_bytes)
         slice_cfg.input_path = tmp_slice
-        slice_res = run_wordcount_job(slice_cfg, mapper, reducer)
+        slice_res = run_job(slice_cfg, "wordcount")
     want_top = top_k_model(base_counts, TOP_K)
     if slice_res.top[:TOP_K] != want_top:
         print(json.dumps({"error": "top-k parity FAILED vs reference model"}))

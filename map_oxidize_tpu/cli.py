@@ -47,6 +47,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num-shards", type=int, default=0,
                    help="device mesh shards (0 = all local devices, 1 = single)")
     p.add_argument("--tokenizer", choices=["ascii", "unicode"], default="ascii")
+    p.add_argument("--mapper", choices=["auto", "device", "native", "python"],
+                   default="auto",
+                   help="map-phase placement: TPU kernel, C++ host loop, or "
+                        "pure Python (auto: device on accelerator)")
     p.add_argument("--no-native", action="store_true",
                    help="disable the C++ tokenizer hot loop")
     p.add_argument("--checkpoint-dir", default=None,
@@ -70,6 +74,8 @@ def config_from_args(args: argparse.Namespace) -> JobConfig:
         backend=args.backend,
         num_shards=args.num_shards,
         tokenizer=args.tokenizer,
+        mapper="python" if args.no_native and args.mapper == "auto"
+               else args.mapper,
         use_native=not args.no_native,
         checkpoint_dir=args.checkpoint_dir,
         keep_intermediates=args.keep_intermediates,
@@ -93,18 +99,9 @@ def main(argv: list[str] | None = None) -> int:
         if val:
             _log.warning("%s is not wired into the runtime yet; ignoring", flag)
 
-    from map_oxidize_tpu.runtime.driver import run_wordcount_job
+    from map_oxidize_tpu.runtime import run_job
 
-    if args.workload == "wordcount":
-        from map_oxidize_tpu.workloads.wordcount import make_wordcount
-
-        mapper, reducer = make_wordcount(config.tokenizer, config.use_native)
-    else:
-        from map_oxidize_tpu.workloads.bigram import make_bigram
-
-        mapper, reducer = make_bigram(config.tokenizer)
-
-    result = run_wordcount_job(config, mapper, reducer)
+    result = run_job(config, args.workload)
     print(result.top_report(config.top_k))  # reference stdout, main.rs:188-191
     return 0
 

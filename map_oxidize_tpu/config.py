@@ -42,6 +42,12 @@ class JobConfig:
     #: tokenizer mode: 'ascii' (C++-accelerated byte path) or 'unicode'
     #: (exact Rust split_whitespace/to_lowercase semantics, main.rs:96-97)
     tokenizer: str = "ascii"
+    #: map-phase placement: 'device' tokenizes+hashes on the TPU itself,
+    #: 'native' uses the C++ host loop, 'python' the pure fallback; 'auto'
+    #: picks device on an accelerator, native on cpu
+    mapper: str = "auto"
+    #: per-chunk unique-key slots for the device mapper output
+    device_chunk_keys: int = 1 << 19
     #: output file (reference: "final_result.txt", main.rs:174)
     output_path: str = "final_result.txt"
     #: directory for spill/checkpoint artifacts; None disables checkpointing
@@ -66,6 +72,11 @@ class JobConfig:
             raise ValueError("batch_size and key_capacity must be positive")
         if self.initial_key_capacity <= 0:
             raise ValueError("initial_key_capacity must be positive")
+        if self.mapper not in ("auto", "device", "native", "python"):
+            raise ValueError(
+                f"mapper must be auto|device|native|python, got {self.mapper!r}")
+        if self.device_chunk_keys <= 0:
+            raise ValueError("device_chunk_keys must be positive")
         if self.num_chunks <= 0 and self.chunk_bytes <= 0:
             raise ValueError("chunk_bytes must be positive (or set num_chunks)")
         if self.top_k <= 0 or self.num_map_workers <= 0:

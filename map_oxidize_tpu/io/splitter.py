@@ -39,6 +39,46 @@ def iter_chunks(path: str, chunk_bytes: int) -> Iterator[bytes]:
             carry = block[nl + 1 :]
 
 
+_ASCII_WS = b" \t\n\r\x0b\x0c"
+
+
+def _last_ws(block: bytes) -> int:
+    """Index of the last ASCII-whitespace byte in ``block`` or -1."""
+    best = -1
+    for w in _ASCII_WS:
+        i = block.rfind(w)
+        if i > best:
+            best = i
+    return best
+
+
+def iter_chunks_capped(path: str, chunk_bytes: int):
+    """Yield chunks of AT MOST ``chunk_bytes``, split at whitespace.
+
+    For consumers with a fixed-size device buffer (the on-device tokenizer):
+    token semantics only require that no token straddles a chunk, and any
+    ASCII whitespace is a safe cut point — newline alignment is not needed.
+    A single token longer than ``chunk_bytes`` is hard-split (and counted as
+    two tokens); at real chunk sizes that means a >32MB whitespace-free run.
+    """
+    with open(path, "rb") as f:
+        carry = b""
+        while True:
+            block = carry + f.read(chunk_bytes - len(carry))
+            if not block:
+                return
+            if len(block) < chunk_bytes:
+                yield block
+                return
+            cut = _last_ws(block)
+            if cut == -1:
+                yield block          # pathological giant token: hard split
+                carry = b""
+            else:
+                yield block[: cut + 1]
+                carry = block[cut + 1:]
+
+
 def plan_chunks(path: str, chunk_bytes: int, num_chunks: int = 0) -> tuple[int, int]:
     """Return (num_chunks_estimate, chunk_bytes).  If ``num_chunks`` is given,
     derive chunk_bytes from the file size instead (reference semantics:

@@ -1,0 +1,61 @@
+"""Runtime: engines, executors, job drivers.
+
+``run_job`` is the one-call entry point: it resolves where the map phase
+runs (device kernel vs native C++ host loop vs Python fallback) and
+dispatches to the matching driver.
+"""
+
+from __future__ import annotations
+
+from map_oxidize_tpu.config import JobConfig
+from map_oxidize_tpu.utils.logging import get_logger
+
+_log = get_logger(__name__)
+
+
+def resolve_mapper(config: JobConfig, workload: str) -> str:
+    """'auto' -> 'device' on an accelerator, 'native' on cpu.  Workloads the
+    device mapper does not implement yet fall back to the host path."""
+    mode = config.mapper
+    if mode == "auto":
+        from map_oxidize_tpu.runtime.engine import pick_device
+
+        mode = "device" if pick_device(config.backend).platform != "cpu" \
+            else "native"
+    if mode == "device" and workload not in ("wordcount",):
+        _log.info("device mapper does not implement %r yet; using native",
+                  workload)
+        mode = "native"
+    if mode == "device" and config.tokenizer != "ascii":
+        _log.info("device mapper is ascii-only; using native for %r",
+                  config.tokenizer)
+        mode = "native"
+    if mode == "device" and config.num_shards > 1:
+        _log.info("device mapper is single-chip for now; using native for "
+                  "%d shards", config.num_shards)
+        mode = "native"
+    return mode
+
+
+def run_job(config: JobConfig, workload: str = "wordcount"):
+    """Run a built-in workload end to end with the best available map path."""
+    mode = resolve_mapper(config, workload)
+    if mode == "device":
+        from map_oxidize_tpu.runtime.device_map import run_device_wordcount_job
+
+        return run_device_wordcount_job(config)
+
+    from map_oxidize_tpu.runtime.driver import run_wordcount_job
+
+    use_native = mode == "native"
+    if workload == "wordcount":
+        from map_oxidize_tpu.workloads.wordcount import make_wordcount
+
+        mapper, reducer = make_wordcount(config.tokenizer, use_native)
+    elif workload == "bigram":
+        from map_oxidize_tpu.workloads.bigram import make_bigram
+
+        mapper, reducer = make_bigram(config.tokenizer, use_native)
+    else:
+        raise ValueError(f"unknown workload {workload!r}")
+    return run_wordcount_job(config, mapper, reducer)

@@ -1,0 +1,145 @@
+"""Device-map job driver: the whole map+reduce on the TPU.
+
+The host's role in this path is reduced to what only it can do: stream file
+bytes, ship them to HBM, and keep the hash -> token-bytes dictionary (sliced
+from raw chunk bytes at device-reported representative offsets).  Tokenize,
+hash, combine, and the streaming reduce all happen on device
+(:mod:`map_oxidize_tpu.ops.device_tokenize` + the accumulator merge), so
+throughput is bounded by the host->device link and chip compute, not the
+host CPU — the reference runs this entire phase on host threads
+(``/root/reference/src/main.rs:53-101``).
+
+Pipelining: chunk N+1's upload + kernel are dispatched (async) *before*
+chunk N's small dictionary readback blocks, so the fixed fetch latency of a
+remote-attached device hides behind compute.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from map_oxidize_tpu.api import SumReducer
+from map_oxidize_tpu.config import JobConfig
+from map_oxidize_tpu.io.splitter import iter_chunks_capped
+from map_oxidize_tpu.io.writer import write_final_result
+from map_oxidize_tpu.ops.device_tokenize import DeviceTokenizer, token_at
+from map_oxidize_tpu.ops.hashing import HashDictionary
+from map_oxidize_tpu.runtime.driver import JobResult, _readback
+from map_oxidize_tpu.runtime.engine import (
+    CapacityError,
+    DeviceReduceEngine,
+    next_pow2,
+)
+from map_oxidize_tpu.utils.logging import get_logger
+from map_oxidize_tpu.utils.profiling import Metrics
+
+_log = get_logger(__name__)
+
+
+@lru_cache(maxsize=None)
+def _prefix_packer(m: int):
+    """[3, m] uint32 overflow fetch, used only when per-chunk novelty
+    exceeds the kernel's pre-packed ``fetch_keys`` rows."""
+    def pack(hi, lo, reps):
+        return jnp.stack([hi[:m], lo[:m], reps[:m].astype(jnp.uint32)])
+    return jax.jit(pack)
+
+
+class _DictBuilder:
+    """Builds the hash -> token-bytes dictionary from device outputs.
+
+    The kernel pre-packs (scalars + first ``fetch_keys`` dictionary rows)
+    into one array, so the steady-state cost here is a single host fetch per
+    chunk — fetch latency is the remote-device tax, so one is the budget.
+    """
+
+    def __init__(self, out_keys: int, fetch_keys: int):
+        self.dictionary = HashDictionary()
+        self.out_keys = out_keys
+        self.fetch_keys = min(fetch_keys, out_keys)
+        self.records_in = 0
+
+    def process(self, chunk: bytes, outs) -> None:
+        u_hi, u_lo, counts, reps, packed_dev = outs
+        packed = np.asarray(packed_dev)  # THE one blocking fetch per chunk
+        nu, ndrop, ntok = packed[:3].astype(np.int64).tolist()
+        if ndrop:
+            raise CapacityError(
+                f"{ndrop} unique keys dropped in a chunk: raise "
+                "device_chunk_keys above the per-chunk distinct-key count"
+            )
+        self.records_in += ntok
+        if nu == 0:
+            return
+        f = self.fetch_keys
+        if nu <= f:
+            hi, lo, rep = (packed[3:3 + nu],
+                           packed[3 + f:3 + f + nu],
+                           packed[3 + 2 * f:3 + 2 * f + nu])
+        else:  # rare: more novelty than the pre-packed window
+            m = min(next_pow2(nu), self.out_keys)
+            over = np.asarray(_prefix_packer(m)(u_hi, u_lo, reps))
+            hi, lo, rep = over[0][:nu], over[1][:nu], over[2][:nu]
+        h64 = ((hi.astype(np.uint64) << np.uint64(32))
+               | lo.astype(np.uint64)).tolist()
+        d = self.dictionary
+        rl = rep.astype(np.int64).tolist()
+        for i, h in enumerate(h64):
+            if d.get(h) is None:
+                d.add(h, token_at(chunk, rl[i]))
+
+
+def run_device_wordcount_job(config: JobConfig) -> JobResult:
+    """Word count with the map phase on device (single chip)."""
+    config.validate()
+    metrics = Metrics()
+    engine = DeviceReduceEngine(config, SumReducer())
+    tok = DeviceTokenizer(config.chunk_bytes, config.device_chunk_keys,
+                          device=engine.device)
+    dicts = _DictBuilder(config.device_chunk_keys, tok.fetch_keys)
+
+    pending: tuple | None = None
+    n_chunks = 0
+    with metrics.phase("map+reduce"):
+        for chunk in iter_chunks_capped(config.input_path, config.chunk_bytes):
+            outs = tok.map_chunk_device(chunk)          # async upload + kernel
+            engine.feed_device(outs[0], outs[1], outs[2])  # async merge
+            if pending is not None:
+                dicts.process(*pending)   # blocks; overlaps current compute
+            pending = (chunk, outs)
+            n_chunks += 1
+            # the dictionary length is the exact global distinct-key count
+            # (one chunk behind) — feed it back so capacity growth rarely
+            # needs its own device sync
+            engine.hint_live_upper_bound(
+                len(dicts.dictionary) + config.device_chunk_keys)
+        if pending is not None:
+            dicts.process(*pending)
+
+    with metrics.phase("finalize"):
+        counts = _readback(engine, dicts.dictionary)
+        top = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))[
+            : config.top_k]
+
+    total = sum(counts.values())
+    if dicts.records_in and total != dicts.records_in:
+        raise RuntimeError(
+            f"count conservation violated: device tokenized "
+            f"{dicts.records_in} tokens but counts sum to {total}"
+        )
+
+    with metrics.phase("write"):
+        if config.output_path:
+            write_final_result(config.output_path, counts.items())
+
+    metrics.set("records_in", dicts.records_in)
+    metrics.set("distinct_keys", len(counts))
+    metrics.set("chunks", n_chunks)
+    result = JobResult(counts=counts, top=top, metrics=metrics.summary())
+    if config.metrics:
+        _log.info("metrics: %s", result.metrics)
+    return result

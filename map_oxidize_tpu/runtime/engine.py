@@ -75,6 +75,12 @@ def next_pow2(n: int) -> int:
     return 1 << max(n - 1, 1).bit_length()
 
 
+@partial(jax.jit, donate_argnums=(0, 1, 2))
+def _grow_concat(hi, lo, vals, p_hi, p_lo, p_vals):
+    return (jnp.concatenate([hi, p_hi]), jnp.concatenate([lo, p_lo]),
+            jnp.concatenate([vals, p_vals]))
+
+
 class StreamingEngineBase(abc.ABC):
     """Shared host-side surface: batch padding, the feed loop, and the
     health-check cadence.  Subclasses own the device state and the merge
@@ -282,24 +288,35 @@ class DeviceReduceEngine(StreamingEngineBase):
 
     def _apply_grow(self, new_cap: int) -> None:
         pad = new_cap - self.capacity
-        hi, lo, vals = self._acc
-        p_hi, p_lo, p_vals = make_accumulator(
-            pad, self.value_shape, self.value_dtype, self.combine
+        p = jax.device_put(
+            make_accumulator(pad, self.value_shape, self.value_dtype,
+                             self.combine),
+            self.device,
         )
-        self._acc = [
-            jnp.concatenate([hi, jax.device_put(p_hi, self.device)]),
-            jnp.concatenate([lo, jax.device_put(p_lo, self.device)]),
-            jnp.concatenate([vals, jax.device_put(p_vals, self.device)]),
-        ]
+        # jitted concat: unjitted op-by-op dispatch costs hundreds of ms per
+        # op on a remote-attached device
+        self._acc = list(_grow_concat(*self._acc, *p))
 
     def _merge_batch(self, padded) -> None:
-        incoming = self._incoming(padded[0].shape[0])
-        self._ensure_capacity(incoming)
         batch = jax.device_put(padded, self.device)
+        self.feed_device(*batch, count_rows=False)
+
+    def feed_device(self, hi, lo, vals, count_rows: bool = True) -> None:
+        """Merge a device-resident batch — the hand-off used by the on-device
+        map path (no host staging, padding, or transfer)."""
+        incoming = self._incoming(hi.shape[0])
+        self._ensure_capacity(incoming)
+        if count_rows:
+            self.rows_fed += hi.shape[0]
         *self._acc, self._n_unique, self._ovf = merge_into_accumulator(
-            *self._acc, self._ovf, *batch, combine=self.combine
+            *self._acc, self._ovf, hi, lo, vals, combine=self.combine
         )
         self._n_live_ub += incoming
+
+    def hint_live_upper_bound(self, ub: int) -> None:
+        """Tighten the host-side live-key bound from external exact knowledge
+        (e.g. the dictionary's distinct-key count), avoiding growth syncs."""
+        self._n_live_ub = min(self._n_live_ub, ub)
 
     def _check_health(self) -> None:
         dropped = int(self._ovf)  # host sync point

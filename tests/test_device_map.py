@@ -1,0 +1,65 @@
+"""End-to-end device-map path vs the reference-semantics model."""
+
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from map_oxidize_tpu.config import JobConfig
+from map_oxidize_tpu.runtime import resolve_mapper, run_job
+from map_oxidize_tpu.runtime.device_map import run_device_wordcount_job
+from map_oxidize_tpu.workloads.reference_model import top_k_model, wordcount_model
+
+
+def _write_corpus(tmp_path, rng, lines=300):
+    words = ["The", "the", "fox,", "dog", "a", "over", "Lazy", "THE.",
+             "multi\tword", "end."]
+    text = "\n".join(" ".join(rng.choice(words, size=11)) for _ in range(lines))
+    p = tmp_path / "corpus.txt"
+    p.write_text(text)
+    return p, text.encode()
+
+
+def test_device_job_matches_model(tmp_path, rng):
+    corpus, raw = _write_corpus(tmp_path, rng)
+    cfg = JobConfig(input_path=str(corpus), output_path=str(tmp_path / "o.txt"),
+                    backend="cpu", mapper="device", chunk_bytes=4096,
+                    device_chunk_keys=1024, initial_key_capacity=256)
+    res = run_device_wordcount_job(cfg)
+    want = wordcount_model([raw])
+    assert res.counts == dict(want)
+    assert res.top == top_k_model(want, 10)
+    # output file written deterministically
+    body = (tmp_path / "o.txt").read_bytes()
+    assert body == b"".join(
+        w + b" " + str(c).encode() + b"\n" for w, c in sorted(want.items())
+    )
+
+
+def test_device_job_multi_chunk_equals_single(tmp_path, rng):
+    corpus, raw = _write_corpus(tmp_path, rng, lines=500)
+    small = JobConfig(input_path=str(corpus), output_path="", backend="cpu",
+                      mapper="device", chunk_bytes=2048,
+                      device_chunk_keys=512, initial_key_capacity=128)
+    big = JobConfig(input_path=str(corpus), output_path="", backend="cpu",
+                    mapper="device", chunk_bytes=1 << 20,
+                    device_chunk_keys=4096)
+    assert run_device_wordcount_job(small).counts == \
+        run_device_wordcount_job(big).counts
+
+
+def test_run_job_dispatch_and_fallbacks(tmp_path, rng):
+    corpus, raw = _write_corpus(tmp_path, rng, lines=50)
+    # unicode tokenizer cannot run on device -> native fallback, same counts
+    cfg_dev = JobConfig(input_path=str(corpus), output_path="", backend="cpu",
+                        mapper="device", chunk_bytes=4096,
+                        device_chunk_keys=1024)
+    cfg_uni = JobConfig(input_path=str(corpus), output_path="", backend="cpu",
+                        mapper="device", tokenizer="unicode")
+    assert resolve_mapper(cfg_uni, "wordcount") == "native"
+    assert resolve_mapper(cfg_dev, "bigram") == "native"
+    got_dev = run_job(cfg_dev, "wordcount").counts
+    got_py = run_job(
+        JobConfig(input_path=str(corpus), output_path="", backend="cpu",
+                  mapper="python"), "wordcount").counts
+    assert got_dev == got_py == dict(wordcount_model([raw]))

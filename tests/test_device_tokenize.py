@@ -1,0 +1,92 @@
+"""Device tokenizer vs the Python reference semantics.
+
+The device path uses a different hash family (prefix-summable polynomial pair)
+than the host mappers (FNV-1a64) — parity is on the (token -> count) mapping
+reconstructed through representative offsets, exactly how the real driver
+builds its dictionary.
+"""
+
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from map_oxidize_tpu.ops.device_tokenize import (
+    DeviceTokenizer,
+    token_at,
+)
+from map_oxidize_tpu.ops.hashing import join_u64
+
+
+def _device_counts(chunk: bytes, chunk_bytes: int = 4096, out_keys: int = 1024):
+    tok = DeviceTokenizer(chunk_bytes, out_keys)
+    u_hi, u_lo, counts, reps, packed = [
+        np.asarray(x) for x in tok.map_chunk_device(chunk)
+    ]
+    nu, n_dropped, n_tokens = packed[:3].astype(np.int64).tolist()
+    assert int(n_dropped) == 0
+    nu = int(nu)
+    got = {}
+    seen_hashes = set()
+    for h, c, r in zip(join_u64(u_hi[:nu], u_lo[:nu]).tolist(),
+                       counts[:nu].tolist(), reps[:nu].tolist()):
+        word = token_at(chunk, r)
+        assert h not in seen_hashes
+        seen_hashes.add(h)
+        assert word not in got, f"two hashes for {word!r}"
+        got[word] = c
+    return got, int(n_tokens)
+
+
+CASES = [
+    b"",
+    b"   \t\n  ",
+    b"hello",
+    b"The quick Brown fox JUMPS over the lazy dog, the the THE",
+    b"a b c d e f g h a b c a b a",
+    b"tabs\tand\nnewlines\rand\x0bvertical\x0cfeeds mixed  double  spaces",
+    b"punct, stays! attached. to? words; always: (parens) [too]",
+    b"x" * 1000 + b" " + b"y" * 3 + b" end",
+    "unicode café naïve 中文 words".encode("utf-8"),
+    b"trailing space ",
+    b" leading",
+    b"A" * 512,
+    b"a \x00b \x00ab ab b",  # NUL bytes are token bytes, not separators
+]
+
+
+@pytest.mark.parametrize("case", CASES, ids=range(len(CASES)))
+def test_device_matches_python(case):
+    got, n_tokens = _device_counts(case)
+    want = Counter(case.lower().split())
+    assert got == dict(want)
+    assert n_tokens == sum(want.values())
+
+
+def test_chunk_boundary_padding(rng):
+    """A chunk that exactly fills chunk_bytes (no pad) and one that ends
+    mid-token must both count correctly."""
+    text = b"alpha beta gamma " * 16
+    got, _ = _device_counts(text[:256], chunk_bytes=256)
+    assert got == dict(Counter(text[:256].lower().split()))
+
+
+def test_random_corpus_with_duplicates(rng):
+    words = [bytes(rng.choice(list(b"abcdeXYZ,."),
+                              size=rng.integers(1, 10)))
+             for _ in range(300)]
+    chunk = b" ".join(words[i] for i in rng.integers(0, 300, size=20_000))
+    got, n_tokens = _device_counts(chunk, chunk_bytes=1 << 20,
+                                   out_keys=4096)
+    want = Counter(chunk.lower().split())
+    assert got == dict(want)
+    assert n_tokens == 20_000
+
+
+def test_out_keys_overflow_detected():
+    chunk = b" ".join(b"w%d" % i for i in range(200))
+    tok = DeviceTokenizer(4096, out_keys=64)
+    *_, packed = [np.asarray(x) for x in tok.map_chunk_device(chunk)]
+    n_unique, n_dropped, _ = packed[:3].astype(np.int64).tolist()
+    assert n_unique == 200
+    assert n_dropped == 136
