@@ -33,6 +33,7 @@ periodically and raise rather than silently dropping keys.
 from __future__ import annotations
 
 import abc
+from functools import partial
 
 import numpy as np
 import jax
