@@ -60,6 +60,10 @@ class Mapper(abc.ABC):
     #: shape of one value row ((),) scalar by default; k-means uses (d+1,)
     value_shape: tuple = ()
     value_dtype = np.int32
+    #: True when every emitted key appears in the chunk's dictionary (string
+    #: keyed workloads).  Lets the driver pass the dictionary's exact size to
+    #: the engine as a distinct-key bound (no growth syncs, no over-growth).
+    keys_have_dictionary: bool = False
 
     @abc.abstractmethod
     def map_chunk(self, chunk: bytes) -> MapOutput:

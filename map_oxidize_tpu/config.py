@@ -27,7 +27,7 @@ class JobConfig:
     chunk_bytes: int = 32 * 1024 * 1024
     #: max rows per device feed batch; short batches are padded only to the
     #: next power of two, so tiny chunks don't pay full-batch sort cost
-    batch_size: int = 1 << 20
+    batch_size: int = 1 << 18
     #: hard upper bound on distinct keys on device (accumulator max size)
     key_capacity: int = 1 << 22
     #: starting accumulator capacity; grows by sentinel-padding (4x steps)

@@ -20,30 +20,57 @@ from typing import Iterator
 
 
 def iter_chunks(path: str, chunk_bytes: int) -> Iterator[bytes]:
-    """Yield newline-aligned byte-range chunks of ~``chunk_bytes`` each."""
-    with open(path, "rb") as f:
+    """Yield newline-aligned chunks of AT MOST ``chunk_bytes`` each.
+
+    Yields ``memoryview``s over per-chunk buffers filled with ``readinto`` —
+    one kernel->user copy per byte, no re-slicing copies (the map hot loop
+    takes any buffer-protocol object).  The carry (the partial trailing line)
+    is the only re-copied region.
+
+    Cut policy — identical, by contract, to the native mmap path
+    (``moxt_map_range`` in native/csrc/moxt_native.cpp), so chunking-dependent
+    workloads (bigram pairs do not straddle chunks) count the same on either
+    path: a fixed window of ``chunk_bytes`` is cut at its last newline,
+    falling back to the last ASCII whitespace (token semantics only need
+    whitespace boundaries), then to a hard split for a window-sized token —
+    host residency stays O(chunk_bytes) no matter the input (the reference
+    buffers whole lines, main.rs:44-48).
+    """
+    with open(path, "rb", buffering=0) as f:
+        size = os.fstat(f.fileno()).st_size
+        off = 0      # bytes yielded so far
         carry = b""
-        while True:
-            block = f.read(chunk_bytes)
-            if not block:
-                if carry:
-                    yield carry
+        while off < size:
+            want = min(chunk_bytes, size - off)
+            buf = bytearray(want)
+            pos = len(carry)
+            buf[:pos] = carry
+            while pos < want:  # raw files may short-read; fill the window
+                n = f.readinto(memoryview(buf)[pos:])
+                if not n:
+                    break
+                pos += n
+            if pos == 0:
                 return
-            block = carry + block
-            # extend to next newline so no line straddles chunks
-            nl = block.rfind(b"\n")
-            if nl == -1:
-                carry = block
-                continue
-            yield block[: nl + 1]
-            carry = block[nl + 1 :]
+            mv = memoryview(buf)[:pos]
+            if off + pos >= size or pos < want:
+                yield mv           # final window: uncut, like the C path
+                return
+            cut = buf.rfind(b"\n", 0, pos)
+            if cut == -1:
+                cut = _last_ws(mv)  # newline-free: any whitespace
+            consumed = (cut + 1) if cut != -1 else pos  # giant token: hard
+            yield mv[:consumed]
+            carry = bytes(mv[consumed:pos])
+            off += consumed
 
 
 _ASCII_WS = b" \t\n\r\x0b\x0c"
 
 
-def _last_ws(block: bytes) -> int:
+def _last_ws(block) -> int:
     """Index of the last ASCII-whitespace byte in ``block`` or -1."""
+    block = bytes(block) if not isinstance(block, (bytes, bytearray)) else block
     best = -1
     for w in _ASCII_WS:
         i = block.rfind(w)

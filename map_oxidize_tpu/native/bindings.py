@@ -25,3 +25,15 @@ def load_or_none():
         _log.info("native tokenizer unavailable (%s); using Python map path", e)
         _cached = None
     return _cached
+
+
+def stream_or_none(ngram: int = 1):
+    """A per-thread :class:`~map_oxidize_tpu.native.build.StreamPool` (the
+    driver-facing flavour: cross-chunk C++ dictionary, delta drains, one
+    stream per map worker thread), or None when the native build is
+    unavailable."""
+    if load_or_none() is None:
+        return None
+    from map_oxidize_tpu.native.build import StreamPool
+
+    return StreamPool(ngram)

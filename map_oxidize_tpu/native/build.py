@@ -3,7 +3,19 @@
 The shared library is compiled on first use with g++ (no pybind11 in the
 image; ctypes keeps the binding layer dependency-free) and cached beside the
 source, keyed by source mtime.  The C call runs with the GIL released —
-ctypes drops it for foreign calls — so map worker threads scale across cores.
+ctypes drops it for foreign calls — so host IO and device dispatch can
+proceed while a chunk maps.
+
+Two wrapper flavours over the same stateful C API (``moxt_new`` /
+``moxt_map`` / ``moxt_chunk_read`` / ``moxt_dict_read``):
+
+* :class:`NativeStream` — one persistent state per workload instance.  The
+  hash->bytes dictionary lives in C++ across chunks and each ``map_chunk``
+  drains only the *delta* of newly seen keys, so steady-state chunks hand
+  back (hash, count) arrays and ~no strings — the per-chunk Python dict
+  rebuild that round 1 paid for is gone.
+* :class:`NativeMapper` — the stateless per-call facade (fresh state each
+  call) used by parity tests and one-shot callers.
 """
 
 from __future__ import annotations
@@ -12,6 +24,7 @@ import ctypes
 import os
 import subprocess
 import tempfile
+import threading
 
 import numpy as np
 
@@ -24,18 +37,6 @@ _log = get_logger(__name__)
 _SRC = os.path.join(os.path.dirname(__file__), "csrc", "moxt_native.cpp")
 _BUILD_DIR = os.path.join(os.path.dirname(__file__), "_build")
 _SO = os.path.join(_BUILD_DIR, "libmoxt_native.so")
-
-
-class _MapResult(ctypes.Structure):
-    _fields_ = [
-        ("hashes", ctypes.POINTER(ctypes.c_uint64)),
-        ("counts", ctypes.POINTER(ctypes.c_int32)),
-        ("tok_off", ctypes.POINTER(ctypes.c_int64)),
-        ("tok_bytes", ctypes.POINTER(ctypes.c_uint8)),
-        ("n_unique", ctypes.c_int64),
-        ("n_tokens", ctypes.c_int64),
-        ("error", ctypes.c_int32),
-    ]
 
 
 def _compile() -> str:
@@ -58,49 +59,209 @@ def _compile() -> str:
     return _SO
 
 
-class NativeMapper:
-    """ctypes wrapper exposing n-gram counting as MapOutput."""
+_lib = None
+_lib_lock = threading.Lock()
 
-    def __init__(self, so_path: str):
-        self._lib = ctypes.CDLL(so_path)
-        self._lib.moxt_map_ngram.restype = ctypes.POINTER(_MapResult)
-        self._lib.moxt_map_ngram.argtypes = [
-            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32,
-        ]
-        self._lib.moxt_free_result.restype = None
-        self._lib.moxt_free_result.argtypes = [ctypes.POINTER(_MapResult)]
+
+def _load_lib():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        lib = ctypes.CDLL(_compile())
+        lib.moxt_new.restype = ctypes.c_void_p
+        lib.moxt_new.argtypes = [ctypes.c_int32]
+        lib.moxt_free.restype = None
+        lib.moxt_free.argtypes = [ctypes.c_void_p]
+        lib.moxt_map.restype = ctypes.c_int32
+        lib.moxt_map.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                 ctypes.c_int64]
+        lib.moxt_chunk_unique.restype = ctypes.c_int64
+        lib.moxt_chunk_unique.argtypes = [ctypes.c_void_p]
+        lib.moxt_chunk_tokens.restype = ctypes.c_int64
+        lib.moxt_chunk_tokens.argtypes = [ctypes.c_void_p]
+        lib.moxt_chunk_read.restype = None
+        lib.moxt_chunk_read.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                        ctypes.c_void_p]
+        lib.moxt_dict_pending.restype = None
+        lib.moxt_dict_pending.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                          ctypes.c_void_p]
+        lib.moxt_dict_read.restype = None
+        lib.moxt_dict_read.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                       ctypes.c_void_p, ctypes.c_void_p]
+        lib.moxt_file_open.restype = ctypes.c_void_p
+        lib.moxt_file_open.argtypes = [ctypes.c_char_p]
+        lib.moxt_file_close.restype = None
+        lib.moxt_file_close.argtypes = [ctypes.c_void_p]
+        lib.moxt_file_size.restype = ctypes.c_int64
+        lib.moxt_file_size.argtypes = [ctypes.c_void_p]
+        lib.moxt_map_range.restype = ctypes.c_int64
+        lib.moxt_map_range.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                       ctypes.c_int64, ctypes.c_int64]
+        _lib = lib
+        return _lib
+
+
+class NativeStream:
+    """Persistent native mapper state: per-chunk (hash, count) columns plus a
+    cross-chunk C++ dictionary drained as deltas.
+
+    Not thread-safe per instance — ``map_chunk`` serializes on a lock (the
+    C++ loop is single-core-bound anyway; concurrent callers would only
+    interleave on one core)."""
+
+    def __init__(self, ngram: int = 1):
+        if not 1 <= ngram <= 16:
+            raise ValueError("ngram must be in [1, 16]")
+        self._lib = _load_lib()
+        self._st = self._lib.moxt_new(ngram)
+        if not self._st:
+            raise RuntimeError("moxt_new failed")
+        self.ngram = ngram
+        self._lock = threading.Lock()
+
+    def close(self) -> None:
+        if self._st:
+            self._lib.moxt_free(self._st)
+            self._st = None
+
+    def __del__(self):  # best-effort; close() is the real API
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def map_chunk(self, chunk, drain_dict: bool = True) -> MapOutput:
+        """Map one chunk (any buffer-protocol object: bytes, memoryview,
+        bytearray — passed to C by pointer, zero-copy)."""
+        view = np.frombuffer(chunk, np.uint8)
+        with self._lock:
+            rc = self._lib.moxt_map(self._st, view.ctypes.data, view.size)
+            return self._collect_locked(rc, drain_dict)
+
+    def _collect_locked(self, rc: int, drain_dict: bool) -> MapOutput:
+        if rc == 1:
+            raise ValueError("64-bit hash collision in native map")
+        if rc:
+            raise RuntimeError(f"native map error {rc}")
+        nu = int(self._lib.moxt_chunk_unique(self._st))
+        n_tokens = int(self._lib.moxt_chunk_tokens(self._st))
+        hashes = np.empty(nu, np.uint64)
+        counts = np.empty(nu, np.int32)
+        if nu:
+            self._lib.moxt_chunk_read(
+                self._st, hashes.ctypes.data, counts.ctypes.data)
+        d = self._drain_dict_locked() if drain_dict else HashDictionary()
+        hi, lo = split_u64(hashes)
+        records = max(n_tokens - (self.ngram - 1), 0) if n_tokens else 0
+        return MapOutput(hi=hi, lo=lo, values=counts, dictionary=d,
+                         records_in=records)
+
+    def iter_file(self, path: str, chunk_bytes: int):
+        """Map a file via the C++ mmap path: zero kernel->user copies, chunk
+        cuts chosen in C (last newline, then last whitespace, then hard cut —
+        the same bounded-carry policy as io.splitter.iter_chunks).  Yields
+        MapOutput per chunk."""
+        f = self._lib.moxt_file_open(os.fsencode(path))
+        if not f:
+            raise OSError(f"cannot open/mmap {path!r}")
+        try:
+            size = int(self._lib.moxt_file_size(f))
+            off = 0
+            while off < size:
+                with self._lock:
+                    consumed = int(self._lib.moxt_map_range(
+                        self._st, f, off, chunk_bytes))
+                    if consumed == -1:
+                        raise ValueError("64-bit hash collision in native map")
+                    if consumed <= 0:
+                        raise RuntimeError(
+                            f"native map_range error {consumed} at {off}")
+                    out = self._collect_locked(0, drain_dict=True)
+                off += consumed
+                yield out
+        finally:
+            self._lib.moxt_file_close(f)
+
+    def _drain_dict_locked(self) -> HashDictionary:
+        n = ctypes.c_int64()
+        nbytes = ctypes.c_int64()
+        self._lib.moxt_dict_pending(self._st, ctypes.byref(n),
+                                    ctypes.byref(nbytes))
+        d = HashDictionary()
+        if not n.value:
+            return d
+        hashes = np.empty(n.value, np.uint64)
+        lens = np.empty(n.value, np.int32)
+        blob = np.empty(max(nbytes.value, 1), np.uint8)
+        self._lib.moxt_dict_read(self._st, hashes.ctypes.data,
+                                 lens.ctypes.data, blob.ctypes.data)
+        raw = blob.tobytes()
+        off = 0
+        add = d.add
+        for h, ln in zip(hashes.tolist(), lens.tolist()):
+            add(h, raw[off:off + ln])
+            off += ln
+        return d
+
+    def drain_dictionary(self) -> HashDictionary:
+        """Novel (hash -> bytes) entries since the last drain."""
+        with self._lock:
+            return self._drain_dict_locked()
+
+
+class StreamPool:
+    """One :class:`NativeStream` per calling thread.
+
+    A single stream serializes on its lock, which would collapse a
+    multi-worker map phase onto one core; per-thread streams keep the
+    GIL-released C calls truly parallel.  Each stream owns its own C++
+    dictionary — the per-chunk deltas from different threads may overlap,
+    but ``HashDictionary.update`` is idempotent (and collision-checking), so
+    the driver-side union is still exact."""
+
+    def __init__(self, ngram: int = 1):
+        self.ngram = ngram
+        self._tls = threading.local()
+        self._streams: list[NativeStream] = []
+        self._lock = threading.Lock()
+
+    def get(self) -> NativeStream:
+        s = getattr(self._tls, "stream", None)
+        if s is None:
+            s = NativeStream(self.ngram)
+            self._tls.stream = s
+            with self._lock:
+                self._streams.append(s)
+        return s
+
+    def map_chunk(self, chunk) -> MapOutput:
+        return self.get().map_chunk(chunk)
+
+    def iter_file(self, path: str, chunk_bytes: int):
+        return self.get().iter_file(path, chunk_bytes)
+
+    def close(self) -> None:
+        with self._lock:
+            for s in self._streams:
+                s.close()
+            self._streams.clear()
+
+
+class NativeMapper:
+    """Stateless facade: a fresh native state per call, full dictionary
+    returned with every chunk.  Used by parity tests and ad-hoc callers;
+    drivers use :class:`NativeStream`."""
+
+    def __init__(self, _so_path: str | None = None):
+        self._lib = _load_lib()
 
     def map_ngram(self, chunk: bytes, n: int) -> MapOutput:
-        rp = self._lib.moxt_map_ngram(chunk, len(chunk), n)
+        s = NativeStream(n)
         try:
-            r = rp.contents
-            if r.error == 1:
-                raise ValueError("64-bit hash collision in native map")
-            if r.error:
-                raise RuntimeError(f"native map error {r.error}")
-            nu = r.n_unique
-            if nu == 0:
-                hashes = np.empty(0, np.uint64)
-                counts = np.empty(0, np.int32)
-                d = HashDictionary()
-            else:
-                hashes = np.ctypeslib.as_array(r.hashes, (nu,)).copy()
-                counts = np.ctypeslib.as_array(r.counts, (nu,)).copy()
-                offs = np.ctypeslib.as_array(r.tok_off, (nu + 1,))
-                blob = bytes(
-                    np.ctypeslib.as_array(r.tok_bytes, (int(offs[nu]),))
-                )
-                d = HashDictionary()
-                ol = offs.tolist()
-                hl = hashes.tolist()
-                for i in range(nu):
-                    d.add(hl[i], blob[ol[i]:ol[i + 1]])
-            records = max(int(r.n_tokens) - (n - 1), 0) if r.n_tokens else 0
-            hi, lo = split_u64(hashes)
-            return MapOutput(hi=hi, lo=lo, values=counts, dictionary=d,
-                             records_in=records)
+            return s.map_chunk(chunk)
         finally:
-            self._lib.moxt_free_result(rp)
+            s.close()
 
     def map_wordcount(self, chunk: bytes) -> MapOutput:
         return self.map_ngram(chunk, 1)
@@ -110,4 +271,5 @@ class NativeMapper:
 
 
 def load_native() -> NativeMapper:
-    return NativeMapper(_compile())
+    _load_lib()
+    return NativeMapper()

@@ -2,41 +2,201 @@
 //
 // This is the TPU-native framework's equivalent of the reference's compiled
 // map path (the Rust `count_words`, /root/reference/src/main.rs:94-101, which
-// allocates a lowercased String per token and upserts a std HashMap).  Here
-// one scan over the chunk does ASCII-whitespace splitting, ASCII lowercasing,
-// FNV-1a 64-bit hashing and open-addressed counting, GIL-free (called via
-// ctypes).  Output is columnar — (hash, count) arrays plus a token-bytes
-// arena — ready for zero-copy hand-off to the device engine.
+// allocates a lowercased String per token and upserts a std HashMap).  The
+// design here is shaped by two measured facts about the build machine:
+//
+//   * one host core — map throughput is single-thread throughput;
+//   * host->TPU link ~26-37 MB/s — raw text can never be shipped to the chip
+//     at a competitive rate, so the host loop IS the map phase and must run
+//     at hundreds of MB/s.
+//
+// Structure (per chunk):
+//
+//   pass 1  SIMD sweep: ASCII-lowercase into a scratch buffer and emit a
+//           whitespace bitmap (1 bit/byte).  AVX-512BW when available.
+//   pass 2  walk the bitmap with tzcnt to extract token runs; hash each
+//           token (moxt64, below); upsert into an open-addressed table whose
+//           slots hold the first 16 key bytes INLINE — the common repeat-hit
+//           compares two registers instead of chasing an arena pointer.
+//
+// Chunk outputs are columnar (hash, count) arrays; token strings go to a
+// persistent hash->bytes dictionary (per mapper state, across chunks) that
+// Python drains as a delta after each chunk — so steady-state chunks hand
+// back ~no strings at all.
 //
 // Semantics contract (tests enforce bit-identity with the Python fallback):
 //   * token boundaries == Python bytes.split(): runs of {' ','\t','\n','\r',
 //     '\v','\f'} separate tokens, no empty tokens;
 //   * lowercase == Python bytes.lower(): only bytes 'A'..'Z' change;
-//   * hash == ops/hashing.py fnv1a64_bytes (FNV-1a 64);
+//   * hash == ops/hashing.py moxt64_bytes (spec below);
 //   * n-gram keys (n>=2) are tokens joined by a single ' ' (workloads/
 //     bigram.py), hashed over the joined bytes;
-//   * equal 64-bit hashes with different token bytes abort with error=1 —
-//     the same collision guarantee HashDictionary.add gives.
+//   * equal 64-bit hashes with different key bytes abort with error=1 — full
+//     collision detection, same guarantee HashDictionary.add gives.
 
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
 
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <immintrin.h>
+
 namespace {
 
-constexpr uint64_t kFnvOffset = 0xCBF29CE484222325ULL;
-constexpr uint64_t kFnvPrime = 0x100000001B3ULL;
+// ---------------------------------------------------------------------------
+// moxt64: the canonical 64-bit key hash (mirrored by ops/hashing.moxt64_bytes)
+//
+//   h = len * K3
+//   for each 16-byte block (zero-padded past the end; >=1 round always):
+//       h = fold128((w0 ^ K1 ^ h) * (w1 ^ K2 ^ rotl(h, 32)))
+//   where fold128 xors the high and low halves of the 128-bit product
+//   (wyhash-style — a plain 64-bit multiply only propagates differences
+//   upward and measurably collided on structured bigram keys).
+//   splitmix64 finalizer; h == 2^64-1 (the device padding SENTINEL64) is
+//   remapped to 2^64-2 so no real key can masquerade as padding.
+// ---------------------------------------------------------------------------
+
+constexpr uint64_t kM1 = 0x9E3779B97F4A7C15ULL;
+constexpr uint64_t kM2 = 0xC2B2AE3D27D4EB4FULL;
+constexpr uint64_t kM3 = 0x165667B19E3779F9ULL;
+
+inline uint64_t rotl64(uint64_t x, int r) { return (x << r) | (x >> (64 - r)); }
+
+inline uint64_t moxt64_finish(uint64_t h) {
+  h ^= h >> 30;
+  h *= 0xBF58476D1CE4E5B9ULL;
+  h ^= h >> 27;
+  h *= 0x94D049BB133111EBULL;
+  h ^= h >> 31;
+  if (h == ~0ULL) h = ~0ULL - 1;  // SENTINEL64 guard
+  return h;
+}
+
+inline uint64_t moxt64_round(uint64_t h, uint64_t w0, uint64_t w1) {
+  unsigned __int128 m = (unsigned __int128)(w0 ^ kM1 ^ h) *
+                        (w1 ^ kM2 ^ rotl64(h, 32));
+  return (uint64_t)m ^ (uint64_t)(m >> 64);
+}
+
+// Load up to 16 bytes from p[0..n) into (w0, w1), zero-padded.
+inline void load16_masked(const uint8_t* p, int64_t n, uint64_t* w0,
+                          uint64_t* w1) {
+#if defined(__AVX512BW__) && defined(__AVX512VL__)
+  __mmask16 m = (n >= 16) ? (__mmask16)0xFFFF : (__mmask16)((1u << n) - 1);
+  __m128i v = _mm_maskz_loadu_epi8(m, p);
+  *w0 = (uint64_t)_mm_extract_epi64(v, 0);
+  *w1 = (uint64_t)_mm_extract_epi64(v, 1);
+#else
+  uint8_t buf[16] = {0};
+  memcpy(buf, p, n >= 16 ? 16 : (size_t)n);
+  memcpy(w0, buf, 8);
+  memcpy(w1, buf + 8, 8);
+#endif
+}
+
+// Generic-length hash (n-gram keys, long tokens).
+inline uint64_t moxt64(const uint8_t* p, int64_t n) {
+  uint64_t h = (uint64_t)n * kM3;
+  int64_t i = 0;
+  do {
+    uint64_t w0, w1;
+    int64_t rem = n - i;
+    if (rem >= 16) {
+      memcpy(&w0, p + i, 8);
+      memcpy(&w1, p + i + 8, 8);
+    } else {
+      load16_masked(p + i, rem, &w0, &w1);
+    }
+    h = moxt64_round(h, w0, w1);
+    i += 16;
+  } while (i < n);
+  return moxt64_finish(h);
+}
+
+// ---------------------------------------------------------------------------
+// Pass 1: lowercase + whitespace bitmap
+// ---------------------------------------------------------------------------
 
 inline bool is_ascii_space(uint8_t c) {
-  return c == ' ' || c == '\t' || c == '\n' || c == '\r' || c == '\v' ||
-         c == '\f';
+  return c == ' ' || (c >= '\t' && c <= '\r');
 }
 
-inline uint8_t ascii_lower(uint8_t c) {
-  return (c >= 'A' && c <= 'Z') ? c + 32 : c;
+// low[0..n) = lowercased src; ws bit i set iff src[i] is ASCII whitespace.
+// ws has (n+63)/64 + 2 words: tail bits of the last real word are SET, the
+// first pad word is ALL-ONES (a token ending exactly at a 64-aligned n still
+// finds its end bit), and the second pad word is ZERO (a next-clear scan
+// always lands; callers stop at start >= n).
+void preprocess(const uint8_t* src, int64_t n, uint8_t* low, uint64_t* ws) {
+  int64_t nwords = (n + 63) >> 6;
+  int64_t i = 0;
+#if defined(__AVX512BW__)
+  const __m512i v9 = _mm512_set1_epi8(0x09), vd = _mm512_set1_epi8(0x0D);
+  const __m512i vsp = _mm512_set1_epi8(0x20);
+  const __m512i vA = _mm512_set1_epi8('A'), vZ = _mm512_set1_epi8('Z');
+  const __m512i v32 = _mm512_set1_epi8(0x20);
+  for (; i + 64 <= n; i += 64) {
+    __m512i v = _mm512_loadu_si512(src + i);
+    __mmask64 sp = _mm512_cmpeq_epi8_mask(v, vsp) |
+                   (_mm512_cmpge_epu8_mask(v, v9) &
+                    _mm512_cmple_epu8_mask(v, vd));
+    __mmask64 up = _mm512_cmpge_epu8_mask(v, vA) &
+                   _mm512_cmple_epu8_mask(v, vZ);
+    _mm512_storeu_si512(low + i, _mm512_mask_add_epi8(v, up, v, v32));
+    ws[i >> 6] = (uint64_t)sp;
+  }
+  if (i < n) {
+    int64_t rem = n - i;
+    __mmask64 lm = (rem >= 64) ? ~0ULL : ((~0ULL) >> (64 - rem));
+    __m512i v = _mm512_maskz_loadu_epi8(lm, src + i);
+    __mmask64 sp = _mm512_cmpeq_epi8_mask(v, vsp) |
+                   (_mm512_cmpge_epu8_mask(v, v9) &
+                    _mm512_cmple_epu8_mask(v, vd));
+    __mmask64 up = _mm512_cmpge_epu8_mask(v, vA) &
+                   _mm512_cmple_epu8_mask(v, vZ);
+    _mm512_mask_storeu_epi8(low + i, lm, _mm512_mask_add_epi8(v, up, v, v32));
+    // bytes past n count as whitespace so the final token terminates
+    ws[i >> 6] = (uint64_t)sp | ~lm;
+  }
+#else
+  for (int64_t w = 0; w < nwords; w++) ws[w] = 0;
+  for (; i < n; i++) {
+    uint8_t c = src[i];
+    if (c >= 'A' && c <= 'Z') c += 32;
+    low[i] = c;
+    if (is_ascii_space(src[i]))
+      ws[i >> 6] |= 1ULL << (i & 63);
+  }
+  if (n & 63) ws[nwords - 1] |= (~0ULL) << (n & 63);
+#endif
+  ws[nwords] = ~0ULL;    // next_set landing spot when n is 64-aligned
+  ws[nwords + 1] = 0;    // next_clear landing spot past n
 }
 
-// Growable byte arena for unique-token storage.
+// First set bit at position >= pos.  Only called with a token start < n, and
+// tail bits past n are set, so this always terminates within real words.
+inline int64_t next_set(const uint64_t* ws, int64_t pos) {
+  int64_t w = pos >> 6;
+  uint64_t cur = ws[w] & (~0ULL << (pos & 63));
+  while (cur == 0) cur = ws[++w];
+  return (w << 6) + __builtin_ctzll(cur);
+}
+
+// First clear bit at position >= pos; the all-zero pad word bounds the scan.
+inline int64_t next_clear(const uint64_t* ws, int64_t pos) {
+  int64_t w = pos >> 6;
+  uint64_t cur = ~ws[w] & (~0ULL << (pos & 63));
+  while (cur == 0) cur = ~ws[++w];
+  return (w << 6) + __builtin_ctzll(cur);
+}
+
+// ---------------------------------------------------------------------------
+// Arena + open-addressed tables
+// ---------------------------------------------------------------------------
+
 struct Arena {
   uint8_t* data = nullptr;
   int64_t size = 0;
@@ -54,215 +214,420 @@ struct Arena {
     size += n;
     return at;
   }
+  void reset() { size = 0; }
+  void destroy() { free(data); }
 };
 
-// Open-addressed (hash -> count, token) table, power-of-two capacity.
+// One slot: first 16 key bytes inline so the hot repeat-hit path compares
+// registers, not arena memory.  `epoch` makes per-chunk clearing free.
+// `aref` is 64-bit: the persistent dictionary arena can exceed 4 GiB of
+// cumulative key bytes on wide-key-space jobs (e.g. huge bigram corpora).
+struct Slot {
+  uint64_t hash;
+  uint64_t w0, w1;   // first 16 key bytes (zero-padded)
+  int64_t aref;      // arena offset of the full key bytes
+  uint32_t count;
+  uint32_t len;
+  uint32_t epoch;
+  uint32_t pad_;
+};
+
 struct Table {
-  uint64_t* hashes = nullptr;
-  int32_t* counts = nullptr;
-  int64_t* tok_at = nullptr;   // arena offset of the stored token
-  int32_t* tok_len = nullptr;
-  uint8_t* used = nullptr;
-  int64_t cap = 0;
-  int64_t n = 0;
+  Slot* slots = nullptr;
+  int64_t cap = 0;    // power of two
+  int64_t n = 0;      // live entries in the current epoch
+  uint32_t epoch = 1;
 
   void init(int64_t c) {
     cap = c;
-    hashes = static_cast<uint64_t*>(malloc(c * sizeof(uint64_t)));
-    counts = static_cast<int32_t*>(malloc(c * sizeof(int32_t)));
-    tok_at = static_cast<int64_t*>(malloc(c * sizeof(int64_t)));
-    tok_len = static_cast<int32_t*>(malloc(c * sizeof(int32_t)));
-    used = static_cast<uint8_t*>(calloc(c, 1));
+    slots = static_cast<Slot*>(calloc(c, sizeof(Slot)));
     n = 0;
+    epoch = 1;
   }
-  void destroy() {
-    free(hashes); free(counts); free(tok_at); free(tok_len); free(used);
+  void destroy() { free(slots); }
+
+  void new_epoch() {
+    epoch++;
+    n = 0;
+    if (epoch == 0) {  // u32 wrap: hard-clear once every 4B chunks
+      memset(slots, 0, cap * sizeof(Slot));
+      epoch = 1;
+    }
   }
 
   void grow() {
     Table bigger;
     bigger.init(cap * 2);
+    bigger.epoch = epoch;
     for (int64_t i = 0; i < cap; i++) {
-      if (!used[i]) continue;
-      int64_t j = hashes[i] & (bigger.cap - 1);
-      while (bigger.used[j]) j = (j + 1) & (bigger.cap - 1);
-      bigger.used[j] = 1;
-      bigger.hashes[j] = hashes[i];
-      bigger.counts[j] = counts[i];
-      bigger.tok_at[j] = tok_at[i];
-      bigger.tok_len[j] = tok_len[i];
+      const Slot& s = slots[i];
+      if (s.epoch != epoch || s.count == 0) continue;
+      int64_t j = s.hash & (bigger.cap - 1);
+      while (bigger.slots[j].epoch == epoch && bigger.slots[j].count)
+        j = (j + 1) & (bigger.cap - 1);
+      bigger.slots[j] = s;
     }
     bigger.n = n;
     destroy();
     *this = bigger;
   }
+};
 
-  // Returns false on a 64-bit hash collision (same hash, different bytes).
-  bool upsert(uint64_t h, const uint8_t* tok, int32_t len, Arena& arena) {
-    if (n * 3 >= cap * 2) grow();  // load factor 2/3
-    int64_t i = h & (cap - 1);
-    while (used[i]) {
-      if (hashes[i] == h) {
-        if (tok_len[i] != len ||
-            memcmp(arena.data + tok_at[i], tok, len) != 0) {
-          return false;  // collision: caller aborts, Python path raises too
-        }
-        counts[i]++;
-        return true;
-      }
-      i = (i + 1) & (cap - 1);
+// Upsert outcome
+enum { UP_OK = 0, UP_COLLISION = 1 };
+
+// ---------------------------------------------------------------------------
+// Mapper state (exposed as an opaque handle)
+// ---------------------------------------------------------------------------
+
+struct MoxtState {
+  int32_t ngram = 1;
+  Table chunk;        // per-chunk (hash -> count); epoch-cleared
+  Arena chunk_arena;  // key bytes for the current chunk (reset per chunk)
+  Table dict;         // persistent hash -> bytes across chunks
+  Arena dict_arena;   // persistent key bytes (append-only, insert order)
+  // dictionary append log (insert order == dict_arena order)
+  uint64_t* log_h = nullptr;
+  uint32_t* log_len = nullptr;
+  int64_t log_n = 0, log_cap = 0;
+  int64_t pending_from = 0;        // log cursor for delta reads
+  int64_t pending_bytes_from = 0;  // dict_arena cursor for delta reads
+  // scratch buffers (sized to the largest chunk seen)
+  uint8_t* low = nullptr;
+  uint64_t* ws = nullptr;
+  int64_t scratch_cap = 0;
+  // n-gram scratch
+  uint8_t* key = nullptr;
+  int64_t key_cap = 0;
+  // last-chunk stats
+  int64_t n_tokens = 0;
+  int32_t error = 0;
+
+  void log_push(uint64_t h, uint32_t len) {
+    if (log_n == log_cap) {
+      log_cap = log_cap ? log_cap * 2 : 1 << 12;
+      log_h = static_cast<uint64_t*>(realloc(log_h, log_cap * 8));
+      log_len = static_cast<uint32_t*>(realloc(log_len, log_cap * 4));
     }
-    used[i] = 1;
-    hashes[i] = h;
-    counts[i] = 1;
-    tok_at[i] = arena.append(tok, len);
-    tok_len[i] = len;
-    n++;
-    return true;
+    log_h[log_n] = h;
+    log_len[log_n] = len;
+    log_n++;
   }
 };
 
-inline uint64_t fnv1a(const uint8_t* p, int64_t n, uint64_t h = kFnvOffset) {
-  for (int64_t i = 0; i < n; i++) {
-    h ^= p[i];
-    h *= kFnvPrime;
+// Insert the chunk table's live entries into the persistent dictionary
+// (novel keys only), logging them for the Python-side delta drain.
+inline int dict_absorb(MoxtState* st) {
+  Table& d = st->dict;
+  const Table& c = st->chunk;
+  for (int64_t i = 0; i < c.cap; i++) {
+    const Slot& s = c.slots[i];
+    if (s.epoch != c.epoch || s.count == 0) continue;
+    if (d.n * 2 >= d.cap) d.grow();
+    int64_t j = s.hash & (d.cap - 1);
+    for (;;) {
+      Slot& t = d.slots[j];
+      if (t.count == 0) {
+        t.hash = s.hash;
+        t.w0 = s.w0;
+        t.w1 = s.w1;
+        t.count = 1;
+        t.len = s.len;
+        t.aref = st->dict_arena.append(
+            st->chunk_arena.data + s.aref, s.len);
+        t.epoch = 1;
+        d.n++;
+        st->log_push(s.hash, s.len);
+        break;
+      }
+      if (t.hash == s.hash) {
+        if (t.len != s.len || t.w0 != s.w0 || t.w1 != s.w1 ||
+            (s.len > 16 &&
+             memcmp(st->dict_arena.data + t.aref,
+                    st->chunk_arena.data + s.aref, s.len) != 0))
+          return UP_COLLISION;  // cross-chunk 64-bit collision
+        break;                  // already known
+      }
+      j = (j + 1) & (d.cap - 1);
+    }
   }
-  return h;
+  return UP_OK;
+}
+
+// Upsert one key (bytes at p, length len, first-16 words w0/w1, hash h) into
+// the chunk table.
+inline int chunk_upsert(MoxtState* st, const uint8_t* p, uint32_t len,
+                        uint64_t w0, uint64_t w1, uint64_t h) {
+  Table& t = st->chunk;
+  if (t.n * 2 >= t.cap) t.grow();
+  int64_t mask = t.cap - 1;
+  int64_t j = h & mask;
+  for (;;) {
+    Slot& s = t.slots[j];
+    if (s.epoch != t.epoch || s.count == 0) {
+      s.hash = h;
+      s.w0 = w0;
+      s.w1 = w1;
+      s.count = 1;
+      s.len = len;
+      s.aref = st->chunk_arena.append(p, len);
+      s.epoch = t.epoch;
+      t.n++;
+      return UP_OK;
+    }
+    if (s.hash == h) {
+      if (s.len == len && s.w0 == w0 && s.w1 == w1 &&
+          (len <= 16 ||
+           memcmp(st->chunk_arena.data + s.aref, p, len) == 0)) {
+        s.count++;
+        return UP_OK;
+      }
+      return UP_COLLISION;
+    }
+    j = (j + 1) & mask;
+  }
 }
 
 }  // namespace
 
 extern "C" {
 
-struct MapResult {
-  uint64_t* hashes;    // [n_unique]
-  int32_t* counts;     // [n_unique]
-  int64_t* tok_off;    // [n_unique + 1] offsets into tok_bytes
-  uint8_t* tok_bytes;  // concatenated (lowercased) unique key bytes
-  int64_t n_unique;
-  int64_t n_tokens;    // total tokens scanned in the chunk
-  int32_t error;       // 0 ok; 1 = 64-bit hash collision
-};
+MoxtState* moxt_new(int32_t ngram) {
+  if (ngram < 1) return nullptr;
+  MoxtState* st = new MoxtState();
+  st->ngram = ngram;
+  st->chunk.init(1 << 16);
+  st->dict.init(1 << 16);
+  return st;
+}
 
-// Count n-grams (n=1: word count; n=2: bigrams; ...) over one chunk.
-// Keys are lowercased tokens joined by ' '.  Caller owns the result via
-// moxt_free_result.
-MapResult* moxt_map_ngram(const uint8_t* data, int64_t len, int32_t ngram) {
-  MapResult* r = static_cast<MapResult*>(calloc(1, sizeof(MapResult)));
-  if (ngram < 1) { r->error = 2; return r; }
+void moxt_free(MoxtState* st) {
+  if (!st) return;
+  st->chunk.destroy();
+  st->dict.destroy();
+  st->chunk_arena.destroy();
+  st->dict_arena.destroy();
+  free(st->log_h);
+  free(st->log_len);
+  free(st->low);
+  free(st->ws);
+  free(st->key);
+  delete st;
+}
 
-  Arena arena;          // unique-key storage
-  Table table;
-  table.init(1 << 16);
+// Map one chunk.  Returns 0 ok, 1 = 64-bit hash collision (job must abort;
+// the Python paths raise on the same condition), 2 = bad state.
+int32_t moxt_map(MoxtState* st, const uint8_t* data, int64_t len) {
+  if (!st || st->error == 2) return 2;
+  st->error = 0;
+  st->n_tokens = 0;
+  st->chunk.new_epoch();
+  st->chunk_arena.reset();
+  if (len <= 0) return 0;
 
-  // scratch: the current joined n-gram key (lowercased)
-  int64_t scratch_cap = 1 << 12;
-  uint8_t* scratch = static_cast<uint8_t*>(malloc(scratch_cap));
-  // ring buffer of the last `ngram` token (start, len) pairs in scratch2
-  // — simpler: keep last-(n-1) joined suffix by re-membering token spans.
-  // We store the last n token copies in a small arena that we rebuild.
-  struct Span { int64_t at; int32_t len; };
-  Span* ring = static_cast<Span*>(malloc(ngram * sizeof(Span)));
-  int32_t filled = 0;
-  Arena toks;  // holds lowercased recent tokens (monotone; compacted rarely)
+  if (len > st->scratch_cap) {
+    free(st->low);
+    free(st->ws);
+    st->low = static_cast<uint8_t*>(malloc(len + 64));
+    st->ws = static_cast<uint64_t*>(malloc((((len + 63) >> 6) + 2) * 8));
+    st->scratch_cap = len;
+  }
+  preprocess(data, len, st->low, st->ws);
+  const uint8_t* low = st->low;
+  const uint64_t* ws = st->ws;
+  const int32_t ngram = st->ngram;
 
   int64_t n_tokens = 0;
-  int64_t i = 0;
-  bool ok = true;
-  while (i < len && ok) {
-    while (i < len && is_ascii_space(data[i])) i++;
-    if (i >= len) break;
-    int64_t start = i;
-    while (i < len && !is_ascii_space(data[i])) i++;
-    int32_t tlen = static_cast<int32_t>(i - start);
+  int rc = UP_OK;
 
-    // lowercase the token into the token arena
-    if (toks.size > (64 << 20)) {
-      // compact: keep only the live ring spans
-      Arena fresh;
-      for (int32_t k = 0; k < filled; k++) {
-        int64_t at = fresh.append(toks.data + ring[k].at, ring[k].len);
-        ring[k].at = at;
+  if (ngram == 1) {
+    int64_t pos = 0;
+    while (rc == UP_OK) {
+      int64_t start = next_clear(ws, pos);
+      if (start >= len) break;
+      int64_t end = next_set(ws, start);
+      uint32_t tlen = (uint32_t)(end - start);
+      n_tokens++;
+      uint64_t w0, w1, h;
+      if (tlen <= 16) {
+        load16_masked(low + start, tlen, &w0, &w1);
+        h = moxt64_finish(moxt64_round((uint64_t)tlen * kM3, w0, w1));
+      } else {
+        load16_masked(low + start, 16, &w0, &w1);
+        h = moxt64(low + start, tlen);
       }
-      free(toks.data);
-      toks = fresh;
+      rc = chunk_upsert(st, low + start, tlen, w0, w1, h);
+      pos = end + 1;
     }
-    int64_t at = toks.append(reinterpret_cast<const uint8_t*>(data + start),
-                             tlen);
-    for (int64_t k = at; k < at + tlen; k++)
-      toks.data[k] = ascii_lower(toks.data[k]);
-
-    // slide the ring
-    if (filled == ngram) {
-      memmove(ring, ring + 1, (ngram - 1) * sizeof(Span));
-      filled--;
+  } else {
+    // ring of the last `ngram` token spans in the lowercased buffer
+    struct Span {
+      int64_t at;
+      uint32_t len;
+    };
+    Span ring[16];  // ngram capped at 16 by moxt_new callers (validated below)
+    if (ngram > 16) {
+      st->error = 2;
+      return 2;
     }
-    ring[filled].at = at;
-    ring[filled].len = tlen;
-    filled++;
-    n_tokens++;
-
-    if (filled == ngram) {
-      // build the joined key in scratch
-      int64_t klen = 0;
-      for (int32_t k = 0; k < ngram; k++) klen += ring[k].len + (k ? 1 : 0);
-      if (klen > scratch_cap) {
-        while (scratch_cap < klen) scratch_cap *= 2;
-        scratch = static_cast<uint8_t*>(realloc(scratch, scratch_cap));
+    int32_t filled = 0;
+    int64_t pos = 0;
+    while (rc == UP_OK) {
+      int64_t start = next_clear(ws, pos);
+      if (start >= len) break;
+      int64_t end = next_set(ws, start);
+      pos = end + 1;
+      n_tokens++;
+      if (filled == ngram) {
+        memmove(ring, ring + 1, (ngram - 1) * sizeof(Span));
+        filled--;
+      }
+      ring[filled].at = start;
+      ring[filled].len = (uint32_t)(end - start);
+      filled++;
+      if (filled < ngram) continue;
+      // join with single spaces into the key scratch
+      int64_t klen = ngram - 1;
+      for (int32_t k = 0; k < ngram; k++) klen += ring[k].len;
+      if (klen > st->key_cap) {
+        int64_t nc = st->key_cap ? st->key_cap : 1 << 12;
+        while (nc < klen) nc *= 2;
+        st->key = static_cast<uint8_t*>(realloc(st->key, nc));
+        st->key_cap = nc;
       }
       int64_t w = 0;
       for (int32_t k = 0; k < ngram; k++) {
-        if (k) scratch[w++] = ' ';
-        memcpy(scratch + w, toks.data + ring[k].at, ring[k].len);
+        if (k) st->key[w++] = ' ';
+        memcpy(st->key + w, low + ring[k].at, ring[k].len);
         w += ring[k].len;
       }
-      uint64_t h = fnv1a(scratch, klen);
-      ok = table.upsert(h, scratch, static_cast<int32_t>(klen), arena);
+      uint64_t w0, w1;
+      load16_masked(st->key, klen >= 16 ? 16 : klen, &w0, &w1);
+      uint64_t h = moxt64(st->key, klen);
+      rc = chunk_upsert(st, st->key, (uint32_t)klen, w0, w1, h);
     }
   }
 
-  if (!ok) {
-    r->error = 1;
-  } else {
-    // compact the table into columnar output
-    r->n_unique = table.n;
-    r->n_tokens = n_tokens;
-    r->hashes = static_cast<uint64_t*>(malloc(table.n * sizeof(uint64_t)));
-    r->counts = static_cast<int32_t*>(malloc(table.n * sizeof(int32_t)));
-    r->tok_off = static_cast<int64_t*>(malloc((table.n + 1) * sizeof(int64_t)));
-    int64_t total_tok = 0;
-    for (int64_t t = 0; t < table.cap; t++)
-      if (table.used[t]) total_tok += table.tok_len[t];
-    r->tok_bytes = static_cast<uint8_t*>(malloc(total_tok ? total_tok : 1));
-    int64_t out = 0, off = 0;
-    for (int64_t t = 0; t < table.cap; t++) {
-      if (!table.used[t]) continue;
-      r->hashes[out] = table.hashes[t];
-      r->counts[out] = table.counts[t];
-      r->tok_off[out] = off;
-      memcpy(r->tok_bytes + off, arena.data + table.tok_at[t],
-             table.tok_len[t]);
-      off += table.tok_len[t];
-      out++;
-    }
-    r->tok_off[out] = off;
+  st->n_tokens = n_tokens;
+  if (rc != UP_OK) {
+    st->error = 1;
+    return 1;
   }
-
-  free(scratch);
-  free(ring);
-  free(toks.data);
-  free(arena.data);
-  table.destroy();
-  return r;
+  if (dict_absorb(st) != UP_OK) {
+    st->error = 1;
+    return 1;
+  }
+  return 0;
 }
 
-void moxt_free_result(MapResult* r) {
-  if (!r) return;
-  free(r->hashes);
-  free(r->counts);
-  free(r->tok_off);
-  free(r->tok_bytes);
-  free(r);
+int64_t moxt_chunk_unique(MoxtState* st) { return st->chunk.n; }
+int64_t moxt_chunk_tokens(MoxtState* st) { return st->n_tokens; }
+
+// Copy the chunk's compacted (hash, count) columns into caller buffers of
+// size moxt_chunk_unique().
+void moxt_chunk_read(MoxtState* st, uint64_t* hashes, int32_t* counts) {
+  const Table& t = st->chunk;
+  int64_t out = 0;
+  for (int64_t i = 0; i < t.cap; i++) {
+    const Slot& s = t.slots[i];
+    if (s.epoch != t.epoch || s.count == 0) continue;
+    hashes[out] = s.hash;
+    counts[out] = (int32_t)s.count;
+    out++;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Memory-mapped input: the zero-copy host read path.  The
+// reference buffers the whole corpus line-by-line through a BufReader
+// (/root/reference/src/main.rs:36-51); mmap lets the scan read page-cache
+// pages in place — no kernel->user copy at all on a warm corpus.
+// ---------------------------------------------------------------------------
+
+struct MoxtFile {
+  uint8_t* data;
+  int64_t size;
+};
+
+MoxtFile* moxt_file_open(const char* path) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat sb;
+  if (fstat(fd, &sb) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  MoxtFile* f = new MoxtFile();
+  f->size = sb.st_size;
+  f->data = nullptr;
+  if (f->size > 0) {
+    void* p = mmap(nullptr, f->size, PROT_READ, MAP_PRIVATE, fd, 0);
+    if (p == MAP_FAILED) {
+      close(fd);
+      delete f;
+      return nullptr;
+    }
+    madvise(p, f->size, MADV_SEQUENTIAL);
+    f->data = static_cast<uint8_t*>(p);
+  }
+  close(fd);  // the mapping keeps the file alive
+  return f;
+}
+
+void moxt_file_close(MoxtFile* f) {
+  if (!f) return;
+  if (f->data) munmap(f->data, f->size);
+  delete f;
+}
+
+int64_t moxt_file_size(MoxtFile* f) { return f ? f->size : -1; }
+
+// Map one chunk straight from the mapping: [off, off + consumed), where
+// consumed <= want is cut at the last newline in range (falling back to the
+// last ASCII whitespace, then a hard cut — same bounded-carry policy as the
+// Python splitter).  Returns bytes consumed, 0 at EOF, -1 on a map error
+// (read the error via the state's next moxt_map return or this call's sign).
+int64_t moxt_map_range(MoxtState* st, MoxtFile* f, int64_t off, int64_t want) {
+  if (!st || !f || off < 0 || off >= f->size || want <= 0) return 0;
+  int64_t len = f->size - off;
+  if (len > want) {
+    len = want;
+    const uint8_t* p = f->data + off;
+    int64_t cut = -1;
+    for (int64_t i = len - 1; i >= 0; i--) {
+      if (p[i] == '\n') { cut = i; break; }
+    }
+    if (cut < 0) {
+      for (int64_t i = len - 1; i >= 0; i--) {
+        if (is_ascii_space(p[i])) { cut = i; break; }
+      }
+    }
+    if (cut >= 0) len = cut + 1;  // else: one giant token, hard cut at want
+  }
+  int32_t rc = moxt_map(st, f->data + off, len);
+  if (rc != 0) return -(int64_t)rc;
+  return len;
+}
+
+// Dictionary delta since the last drain: entry count and total bytes.
+void moxt_dict_pending(MoxtState* st, int64_t* n, int64_t* nbytes) {
+  *n = st->log_n - st->pending_from;
+  *nbytes = st->dict_arena.size - st->pending_bytes_from;
+}
+
+// Drain the delta into caller buffers (hashes[n], lens[n], bytes[nbytes],
+// concatenated in insert order) and advance the cursor.
+void moxt_dict_read(MoxtState* st, uint64_t* hashes, int32_t* lens,
+                    uint8_t* bytes) {
+  int64_t n = st->log_n - st->pending_from;
+  for (int64_t i = 0; i < n; i++) {
+    hashes[i] = st->log_h[st->pending_from + i];
+    lens[i] = (int32_t)st->log_len[st->pending_from + i];
+  }
+  memcpy(bytes, st->dict_arena.data + st->pending_bytes_from,
+         st->dict_arena.size - st->pending_bytes_from);
+  st->pending_from = st->log_n;
+  st->pending_bytes_from = st->dict_arena.size;
 }
 
 }  // extern "C"

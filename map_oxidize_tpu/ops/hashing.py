@@ -28,12 +28,61 @@ SENTINEL = 0xFFFFFFFF
 SENTINEL64 = 0xFFFFFFFFFFFFFFFF
 
 
+_M1 = 0x9E3779B97F4A7C15
+_M2 = 0xC2B2AE3D27D4EB4F
+_M3 = 0x165667B19E3779F9
+
+
+def moxt64_bytes(data: bytes) -> int:
+    """The canonical key hash, mirrored bit-for-bit by the C++ hot loop
+    (``native/csrc/moxt_native.cpp`` ``moxt64``).
+
+    Spec: ``h = len * K3``; one round per 16-byte block (zero-padded past the
+    end, at least one round even for empty input):
+
+        ``h = fold128((w0 ^ K1 ^ h) * (w1 ^ K2 ^ rotl(h, 32)))``
+
+    with ``w0``/``w1`` the little-endian u64 halves and ``fold128(m) =
+    lo64(m) ^ hi64(m)`` of the full 128-bit product (wyhash-style folded
+    multiply — a plain 64-bit multiply only propagates differences upward and
+    measurably collides on structured keys); then the splitmix64 finalizer.
+    A result equal to ``SENTINEL64`` (the device padding key) is remapped to
+    ``SENTINEL64 - 1`` so no real key can masquerade as padding.
+
+    Chosen over FNV-1a because FNV's byte-serial multiply chain caps a host
+    core near ~150 MB/s; this runs one (widening) multiply per 16 bytes.
+    """
+    n = len(data)
+    h = (n * _M3) & _MASK64
+    i = 0
+    while True:
+        w0 = int.from_bytes(data[i:i + 8].ljust(8, b"\0"), "little")
+        w1 = int.from_bytes(data[i + 8:i + 16].ljust(8, b"\0"), "little")
+        rot = ((h << 32) | (h >> 32)) & _MASK64
+        m = (w0 ^ _M1 ^ h) * (w1 ^ _M2 ^ rot)
+        h = (m & _MASK64) ^ (m >> 64)
+        i += 16
+        if i >= n:
+            break
+    h ^= h >> 30
+    h = (h * 0xBF58476D1CE4E5B9) & _MASK64
+    h ^= h >> 27
+    h = (h * 0x94D049BB133111EB) & _MASK64
+    h ^= h >> 31
+    if h == SENTINEL64:
+        h = SENTINEL64 - 1
+    return h
+
+
 def fnv1a64_bytes(data: bytes) -> int:
-    """FNV-1a 64-bit of ``data``.  Any native map path must mirror this
-    exactly so all map paths emit identical keys."""
+    """FNV-1a 64-bit of ``data`` (legacy; mapper paths use
+    :func:`moxt64_bytes`).  Shares the SENTINEL64 remap so a pathological
+    token can never alias the device padding key."""
     h = FNV_OFFSET
     for b in data:
         h = ((h ^ b) * FNV_PRIME) & _MASK64
+    if h == SENTINEL64:
+        h = SENTINEL64 - 1
     return h
 
 
@@ -43,10 +92,17 @@ def fnv1a64(token: "bytes | str") -> int:
     return fnv1a64_bytes(token)
 
 
+def moxt64(token: "bytes | str") -> int:
+    if isinstance(token, str):
+        token = token.encode("utf-8")
+    return moxt64_bytes(token)
+
+
 def hash_tokens(tokens) -> np.ndarray:
-    """Hash an iterable of tokens (bytes or str) to a uint64 array."""
+    """Hash an iterable of tokens (bytes or str) to a uint64 array with the
+    canonical mapper hash."""
     return np.fromiter(
-        (fnv1a64(t) for t in tokens), dtype=np.uint64, count=len(tokens)
+        (moxt64(t) for t in tokens), dtype=np.uint64, count=len(tokens)
     )
 
 

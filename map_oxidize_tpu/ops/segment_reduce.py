@@ -119,6 +119,34 @@ def make_accumulator(capacity: int, val_shape=(), val_dtype=jnp.int32, combine="
     return hi, lo, vals
 
 
+@partial(jax.jit, static_argnames=("combine",), donate_argnums=(0, 1, 2, 3, 4))
+def merge_packed_into_accumulator(acc_hi, acc_lo, acc_vals, ovf, packed,
+                                  combine="sum"):
+    """Packed-transfer variant of :func:`merge_into_accumulator` for scalar
+    int32 values: the batch arrives as ONE ``(3, B)`` uint32 array (hi, lo,
+    bitcast values) so the host pays a single transfer per flush — on the
+    measured link every distinct host->device put has a fixed cost, so one
+    packed put beats three plane puts."""
+    b_hi, b_lo = packed[0], packed[1]
+    b_vals = lax.bitcast_convert_type(packed[2], jnp.int32)
+    return merge_into_accumulator(acc_hi, acc_lo, acc_vals, ovf,
+                                  b_hi, b_lo, b_vals, combine=combine)
+
+
+@jax.jit
+def pack_accumulator_state(acc_hi, acc_lo, acc_vals, n_unique, ovf):
+    """Bundle everything finalize needs into ONE ``(3, cap+1)`` uint32 array:
+    row 0 = hi keys, row 1 = lo keys, row 2 = bitcast int32 values, and the
+    last column = (n_unique, dropped-key count, 0).  A device->host fetch
+    costs ~150 ms on the measured link regardless of size, so finalize fetches
+    exactly once instead of five times (hi, lo, vals, n, ovf)."""
+    head = jnp.stack([acc_hi, acc_lo,
+                      lax.bitcast_convert_type(acc_vals, jnp.uint32)])
+    extra = jnp.stack([n_unique.astype(jnp.uint32), ovf.astype(jnp.uint32),
+                       jnp.zeros((), jnp.uint32)])
+    return jnp.concatenate([head, extra[:, None]], axis=1)
+
+
 @partial(jax.jit, static_argnames=("combine",), donate_argnums=(0, 1, 2, 3))
 def merge_into_accumulator(acc_hi, acc_lo, acc_vals, ovf, b_hi, b_lo, b_vals,
                            combine="sum"):

@@ -14,14 +14,16 @@ _log = get_logger(__name__)
 
 
 def resolve_mapper(config: JobConfig, workload: str) -> str:
-    """'auto' -> 'device' on an accelerator, 'native' on cpu.  Workloads the
-    device mapper does not implement yet fall back to the host path."""
+    """'auto' -> 'native' (the measured winner).  The device tokenizer stays
+    opt-in: on the measured deployment the host->HBM link moves ~26-37 MB/s
+    while the native host loop tokenizes at ~400 MB/s, so shipping raw text
+    to the chip is bandwidth-capped an order of magnitude below the host
+    path.  ``mapper="device"`` remains available for deployments with a
+    local PCIe/ICI attach where that trade flips.  Workloads or modes the
+    device mapper does not implement fall back to the host path."""
     mode = config.mapper
     if mode == "auto":
-        from map_oxidize_tpu.runtime.engine import pick_device
-
-        mode = "device" if pick_device(config.backend).platform != "cpu" \
-            else "native"
+        mode = "native"
     if mode == "device" and workload not in ("wordcount",):
         _log.info("device mapper does not implement %r yet; using native",
                   workload)
@@ -30,10 +32,15 @@ def resolve_mapper(config: JobConfig, workload: str) -> str:
         _log.info("device mapper is ascii-only; using native for %r",
                   config.tokenizer)
         mode = "native"
-    if mode == "device" and config.num_shards > 1:
-        _log.info("device mapper is single-chip for now; using native for "
-                  "%d shards", config.num_shards)
-        mode = "native"
+    if mode == "device":
+        # effective shard count: 0 means "all visible devices"
+        from map_oxidize_tpu.runtime.driver import effective_num_shards
+
+        n = effective_num_shards(config)
+        if n > 1:
+            _log.info("device mapper is single-chip for now; using native "
+                      "for %d shards", n)
+            mode = "native"
     return mode
 
 

@@ -40,11 +40,10 @@ class JobResult:
         return format_top_words(self.top, k)
 
 
-def make_engine(config: JobConfig, reducer, value_shape=(), value_dtype=np.int32
-                ) -> StreamingEngineBase:
-    """Pick the engine for the configured shard count: ``num_shards == 1``
-    (or 0 with one visible device) runs single-chip; anything wider builds a
-    mesh and the all_to_all sharded engine."""
+def effective_num_shards(config: JobConfig) -> int:
+    """Resolve ``num_shards == 0`` to the visible device pool for the
+    configured backend — the single source of truth for every caller that
+    must agree with the engine actually built."""
     import jax
 
     n = config.num_shards
@@ -53,6 +52,15 @@ def make_engine(config: JobConfig, reducer, value_shape=(), value_dtype=np.int32
             d for d in jax.devices() if d.platform == config.backend
         ] or jax.devices("cpu")
         n = len(pool)
+    return n
+
+
+def make_engine(config: JobConfig, reducer, value_shape=(), value_dtype=np.int32
+                ) -> StreamingEngineBase:
+    """Pick the engine for the configured shard count: ``num_shards == 1``
+    (or 0 with one visible device) runs single-chip; anything wider builds a
+    mesh and the all_to_all sharded engine."""
+    n = effective_num_shards(config)
     if n <= 1:
         return DeviceReduceEngine(config, reducer, value_shape=value_shape,
                                   value_dtype=value_dtype)
@@ -87,12 +95,24 @@ def run_wordcount_job(config: JobConfig, mapper: Mapper, reducer: Reducer) -> Jo
     metrics = Metrics()
 
     # --- split (plan only; chunks stream lazily — contrast main.rs:16/36-51)
+    native_file_iter = None
     with metrics.phase("split"):
         if config.num_chunks > 0:
             chunks = split_round_robin(config.input_path, config.num_chunks)
         else:
             _, chunk_bytes = plan_chunks(config.input_path, config.chunk_bytes)
-            chunks = iter_chunks(config.input_path, chunk_bytes)
+            # native mmap fast path: C++ scans page-cache pages in place
+            # (zero kernel->user copies) and owns the chunk cuts
+            if hasattr(mapper, "map_file"):
+                native_file_iter = mapper.map_file(config.input_path,
+                                                   chunk_bytes)
+            if native_file_iter is not None:
+                _log.debug(
+                    "native mmap map path: chunks map inline in C++; "
+                    "num_map_workers/max_retries do not apply (a map error "
+                    "here is a hash collision, which no retry can fix)")
+            else:
+                chunks = iter_chunks(config.input_path, chunk_bytes)
 
     # --- map + reduce, fused streaming phase (main.rs:19-22 were barriered)
     engine = make_engine(config, reducer,
@@ -102,12 +122,20 @@ def run_wordcount_job(config: JobConfig, mapper: Mapper, reducer: Reducer) -> Jo
     records_in = 0
     n_chunks = 0
     with metrics.phase("map+reduce"):
-        for _idx, out in run_map_phase(
-            chunks, mapper, config.num_map_workers, config.max_retries
-        ):
+        if native_file_iter is not None:
+            outputs = enumerate(native_file_iter)
+        else:
+            outputs = run_map_phase(
+                chunks, mapper, config.num_map_workers, config.max_retries
+            )
+        for _idx, out in outputs:
             dictionary.update(out.dictionary)
             records_in += out.records_in
             n_chunks += 1
+            if mapper.keys_have_dictionary:
+                # the dictionary covers every key fed so far, so its size is
+                # an exact distinct-key bound — growth needs no device sync
+                engine.hint_total_keys(len(dictionary))
             engine.feed(out)
 
     # --- finalize on device; read back to host strings

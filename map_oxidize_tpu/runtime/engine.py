@@ -46,6 +46,8 @@ from map_oxidize_tpu.ops.segment_reduce import (
     _identity,
     make_accumulator,
     merge_into_accumulator,
+    merge_packed_into_accumulator,
+    pack_accumulator_state,
 )
 from map_oxidize_tpu.ops.topk import top_k_pairs_jit
 from map_oxidize_tpu.utils.logging import get_logger
@@ -130,6 +132,7 @@ class StreamingEngineBase(abc.ABC):
         self._staged = 0
         self._n_unique = None    # device-side live-key count (per last merge)
         self._n_live_ub = 0      # host upper bound on live keys
+        self._total_hint = None  # exact cap on distinct keys, if caller knows
 
     def _round_batch(self, n: int) -> int:
         """Padded size for an ``n``-row slice: next power of two, capped at
@@ -193,14 +196,29 @@ class StreamingEngineBase(abc.ABC):
         """Upper bound on new live keys one padded batch can add."""
         return batch_rows
 
+    def hint_total_keys(self, n: int) -> None:
+        """Tell the engine the job-wide distinct-key count can never exceed
+        ``n`` (e.g. the host dictionary's size for string-keyed workloads).
+        Prevents both over-growth and the device sync a growth decision would
+        otherwise need."""
+        self._total_hint = n
+
     def _ensure_capacity(self, incoming: int) -> None:
         if self.capacity >= self.max_capacity:
             return
-        if self._n_live_ub + incoming > self.capacity and self._n_unique is not None:
-            # the bound would force growth — refresh it from the device first
-            # (the only sync on the feed path, and only near a growth edge)
-            self._n_live_ub = self._read_live()
         needed = self._n_live_ub + incoming
+        if self._total_hint is not None:
+            needed = min(needed, self._total_hint)
+        if needed <= self.capacity:
+            return
+        if self._n_unique is not None:
+            # growth looks necessary — refresh the bound from the device
+            # first (the only sync on the feed path, and only at a growth
+            # edge the hint couldn't rule out)
+            self._n_live_ub = self._read_live()
+            needed = self._n_live_ub + incoming
+            if self._total_hint is not None:
+                needed = min(needed, self._total_hint)
         if needed <= self.capacity:
             return
         new_cap = self.capacity
@@ -298,7 +316,30 @@ class DeviceReduceEngine(StreamingEngineBase):
         # op on a remote-attached device
         self._acc = list(_grow_concat(*self._acc, *p))
 
+    def _packable(self) -> bool:
+        """Scalar int32 values ride the packed single-transfer path (the
+        packed merge bitcasts the value row to int32; other dtypes would be
+        silently reinterpreted, so they take the plain three-plane path)."""
+        return self.value_shape == () and self.value_dtype == np.dtype(np.int32)
+
     def _merge_batch(self, padded) -> None:
+        hi, lo, vals = padded
+        if self._packable():
+            packed = np.empty((3, hi.shape[0]), np.uint32)
+            packed[0] = hi
+            packed[1] = lo
+            packed[2] = vals.view(np.uint32)
+            incoming = self._incoming(hi.shape[0])
+            self._ensure_capacity(incoming)
+            *self._acc, self._n_unique, self._ovf = (
+                merge_packed_into_accumulator(
+                    *self._acc, self._ovf,
+                    jax.device_put(packed, self.device),
+                    combine=self.combine,
+                )
+            )
+            self._n_live_ub += incoming
+            return
         batch = jax.device_put(padded, self.device)
         self.feed_device(*batch, count_rows=False)
 
@@ -328,8 +369,28 @@ class DeviceReduceEngine(StreamingEngineBase):
             )
 
     def _finalize(self):
+        if self._n_unique is None:
+            # no merge ever ran: the accumulator is pristine — answer from
+            # the host without a device round trip
+            return (np.full(self.capacity, SENTINEL, np.uint32),
+                    np.full(self.capacity, SENTINEL, np.uint32),
+                    np.full((self.capacity,) + self.value_shape,
+                            self._pad_val, self.value_dtype), 0)
+        if self._packable():
+            # ONE fetch for everything: keys, values, n_unique, overflow
+            packed = np.asarray(pack_accumulator_state(
+                *self._acc, self._n_unique, self._ovf))
+            dropped = int(packed[1, -1])
+            if dropped:
+                raise CapacityError(
+                    f"{dropped} distinct keys dropped: accumulator exceeded "
+                    f"key_capacity={self.max_capacity}; increase key_capacity"
+                )
+            return (packed[0, :-1], packed[1, :-1],
+                    packed[2, :-1].view(self.value_dtype),
+                    int(packed[0, -1]))
         self._check_health()
-        n = 0 if self._n_unique is None else int(self._n_unique)
+        n = int(self._n_unique)
         return (*self._acc, n)
 
     def _top_k_device(self, k: int):

@@ -56,7 +56,16 @@ def run_map_phase(
 ) -> Iterator[tuple[int, MapOutput]]:
     """Map chunks concurrently; yield ``(chunk_index, MapOutput)`` in
     completion order.  At most ``2 * num_workers`` chunks are in flight, which
-    bounds host memory and backpressures the input reader."""
+    bounds host memory and backpressures the input reader.
+
+    With one worker (or one host core — where extra threads only add
+    scheduler churn) the pool is skipped entirely and chunks map inline."""
+    import os
+
+    if num_workers <= 1 or (os.cpu_count() or 1) <= 1:
+        for idx, chunk in enumerate(chunks):
+            yield idx, _attempt(mapper, chunk, idx, max_retries)
+        return
     max_inflight = max(2, 2 * num_workers)
     with ThreadPoolExecutor(max_workers=num_workers, thread_name_prefix="map") as pool:
         inflight: dict[Future, int] = {}

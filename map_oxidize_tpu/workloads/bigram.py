@@ -20,13 +20,14 @@ from collections import Counter
 import numpy as np
 
 from map_oxidize_tpu.api import Mapper, MapOutput, SumReducer
-from map_oxidize_tpu.ops.hashing import HashDictionary, fnv1a64_bytes, split_u64
+from map_oxidize_tpu.ops.hashing import HashDictionary, moxt64_bytes, split_u64
 from map_oxidize_tpu.workloads.wordcount import tokenize
 
 
 class BigramMapper(Mapper):
     value_shape = ()
     value_dtype = np.int32
+    keys_have_dictionary = True
 
     def __init__(self, tokenizer: str = "ascii", use_native: bool = True):
         self.tokenizer = tokenizer
@@ -34,11 +35,17 @@ class BigramMapper(Mapper):
         if use_native and tokenizer == "ascii":
             from map_oxidize_tpu.native import bindings
 
-            self._native = bindings.load_or_none()
+            self._native = bindings.stream_or_none(ngram=2)
+
+    def map_file(self, path: str, chunk_bytes: int):
+        """Native mmap fast path (see WordCountMapper.map_file)."""
+        if self._native is None:
+            return None
+        return self._native.iter_file(path, chunk_bytes)
 
     def map_chunk(self, chunk: bytes) -> MapOutput:
         if self._native is not None:
-            return self._native.map_bigram(chunk)
+            return self._native.map_chunk(chunk)
         toks = tokenize(chunk, self.tokenizer)
         pairs = Counter(
             toks[i] + b" " + toks[i + 1] for i in range(len(toks) - 1)
@@ -47,7 +54,7 @@ class BigramMapper(Mapper):
         hashes = np.empty(len(pairs), np.uint64)
         values = np.empty(len(pairs), np.int32)
         for i, (key, c) in enumerate(pairs.items()):
-            h = fnv1a64_bytes(key)
+            h = moxt64_bytes(key)
             d.add(h, key)
             hashes[i] = h
             values[i] = c

@@ -26,11 +26,13 @@ from collections import Counter
 import numpy as np
 
 from map_oxidize_tpu.api import Mapper, MapOutput, SumReducer
-from map_oxidize_tpu.ops.hashing import HashDictionary, fnv1a64_bytes, split_u64
+from map_oxidize_tpu.ops.hashing import HashDictionary, moxt64_bytes, split_u64
 
 
-def tokenize(chunk: bytes, mode: str = "ascii") -> list[bytes]:
+def tokenize(chunk, mode: str = "ascii") -> list[bytes]:
     """Split + lowercase, per reference semantics (main.rs:96-97)."""
+    if not isinstance(chunk, bytes):
+        chunk = bytes(chunk)  # splitter may yield memoryviews
     if mode == "ascii":
         return chunk.lower().split()
     if mode == "unicode":
@@ -41,6 +43,7 @@ def tokenize(chunk: bytes, mode: str = "ascii") -> list[bytes]:
 class WordCountMapper(Mapper):
     value_shape = ()
     value_dtype = np.int32
+    keys_have_dictionary = True
 
     def __init__(self, tokenizer: str = "ascii", use_native: bool = True):
         self.tokenizer = tokenizer
@@ -49,18 +52,28 @@ class WordCountMapper(Mapper):
         if self.use_native:
             from map_oxidize_tpu.native import bindings
 
-            self._native = bindings.load_or_none()
+            self._native = bindings.stream_or_none(ngram=1)
+
+    def map_file(self, path: str, chunk_bytes: int):
+        """Native mmap fast path: a MapOutput generator over the file, or
+        None when the C++ loop is unavailable (driver falls back to the
+        streaming splitter + map_chunk)."""
+        if self._native is None:
+            return None
+        return self._native.iter_file(path, chunk_bytes)
 
     def map_chunk(self, chunk: bytes) -> MapOutput:
         if self._native is not None:
-            return self._native.map_wordcount(chunk)
+            # dictionary carries only the delta of newly seen keys — the
+            # driver's per-chunk dictionary.update() accumulates the union
+            return self._native.map_chunk(chunk)
         toks = tokenize(chunk, self.tokenizer)
         counts = Counter(toks)
         d = HashDictionary()
         hashes = np.empty(len(counts), np.uint64)
         values = np.empty(len(counts), np.int32)
         for i, (tok, c) in enumerate(counts.items()):
-            h = fnv1a64_bytes(tok)
+            h = moxt64_bytes(tok)
             d.add(h, tok)
             hashes[i] = h
             values[i] = c

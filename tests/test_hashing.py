@@ -2,10 +2,13 @@ import numpy as np
 import pytest
 
 from map_oxidize_tpu.ops.hashing import (
+    SENTINEL64,
     HashDictionary,
     fnv1a64,
     hash_tokens,
     join_u64,
+    moxt64,
+    moxt64_bytes,
     split_u64,
 )
 
@@ -16,6 +19,28 @@ def test_fnv1a64_known_vectors():
     assert fnv1a64(b"a") == 0xAF63DC4C8601EC8C
     assert fnv1a64(b"foobar") == 0x85944171F73967E8
     assert fnv1a64("foobar") == fnv1a64(b"foobar")
+
+
+def test_moxt64_basic_properties():
+    # deterministic, length-sensitive, 64-bit range, never the sentinel
+    assert moxt64(b"the") == moxt64(b"the")
+    assert moxt64(b"the") != moxt64(b"The")
+    assert moxt64(b"a") != moxt64(b"a\0")  # length is part of the key
+    assert moxt64("foobar") == moxt64(b"foobar")
+    for t in (b"", b"a", b"0123456789abcdef", b"0123456789abcdef0",
+              b"x" * 1000):
+        h = moxt64_bytes(t)
+        assert 0 <= h < 2**64 and h != SENTINEL64
+
+
+def test_moxt64_no_collisions_structured():
+    # the weakness that sank the first moxt64 draft: same-length keys whose
+    # differences sit in cancelling bit positions of w0/w1
+    keys = [b"lurnq wzzbpd", b"lurnq mjzbas"]
+    keys += [f"tok{i:04d} tok{j:04d}".encode()
+             for i in range(100) for j in range(50)]
+    hs = [moxt64_bytes(k) for k in keys]
+    assert len(set(hs)) == len(keys)
 
 
 def test_split_join_roundtrip(rng):
@@ -29,17 +54,17 @@ def test_hash_tokens_order_and_dtype():
     toks = [b"the", b"quick", b"the"]
     out = hash_tokens(toks)
     assert out.dtype == np.uint64
-    assert out[0] == out[2] == fnv1a64(b"the")
-    assert out[1] == fnv1a64(b"quick")
+    assert out[0] == out[2] == moxt64(b"the")
+    assert out[1] == moxt64(b"quick")
 
 
 def test_dictionary_union_and_collision():
     d1, d2 = HashDictionary(), HashDictionary()
-    d1.add(fnv1a64(b"the"), b"the")
-    d2.add(fnv1a64(b"cat"), b"cat")
+    d1.add(moxt64(b"the"), b"the")
+    d2.add(moxt64(b"cat"), b"cat")
     d1.update(d2)
-    assert d1.lookup(fnv1a64(b"cat")) == b"cat"
+    assert d1.lookup(moxt64(b"cat")) == b"cat"
     assert len(d1) == 2
     # same-hash different-bytes must raise (collision detection)
     with pytest.raises(ValueError):
-        d1.add(fnv1a64(b"the"), b"not-the")
+        d1.add(moxt64(b"the"), b"not-the")
