@@ -26,9 +26,12 @@ def build_parser() -> argparse.ArgumentParser:
         prog="map_oxidize_tpu",
         description="TPU-native MapReduce (capabilities of map-oxidize, rebuilt for JAX/XLA)",
     )
-    p.add_argument("workload", choices=["wordcount", "bigram"],
+    p.add_argument("workload",
+                   choices=["wordcount", "bigram", "invertedindex", "kmeans"],
                    help="built-in workload to run")
-    p.add_argument("input", help="input corpus path (reference: shakes.txt)")
+    p.add_argument("input", help="input path: text corpus (reference: "
+                                 "shakes.txt), or a .npy points file for "
+                                 "kmeans")
     p.add_argument("--output", default="final_result.txt",
                    help="final result path (reference: final_result.txt)")
     p.add_argument("--top-k", type=int, default=10,
@@ -53,6 +56,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "pure Python (auto: device on accelerator)")
     p.add_argument("--no-native", action="store_true",
                    help="disable the C++ tokenizer hot loop")
+    p.add_argument("--kmeans-k", type=int, default=16,
+                   help="k-means cluster count (init: first k points)")
+    p.add_argument("--kmeans-iters", type=int, default=1,
+                   help="k-means iterations")
     p.add_argument("--checkpoint-dir", default=None,
                    help="directory for resumable map-output checkpoints")
     p.add_argument("--keep-intermediates", action="store_true")
@@ -79,6 +86,8 @@ def config_from_args(args: argparse.Namespace) -> JobConfig:
         use_native=not args.no_native,
         checkpoint_dir=args.checkpoint_dir,
         keep_intermediates=args.keep_intermediates,
+        kmeans_k=args.kmeans_k,
+        kmeans_iters=args.kmeans_iters,
     ).validate()
 
 

@@ -62,6 +62,10 @@ class JobConfig:
     use_native: bool = True
     #: emit per-phase timing/throughput metrics
     metrics: bool = True
+    #: k-means: cluster count (init = first k points of the input)
+    kmeans_k: int = 16
+    #: k-means: iterations to run
+    kmeans_iters: int = 1
 
     def validate(self) -> "JobConfig":
         if self.tokenizer not in ("ascii", "unicode"):
@@ -81,4 +85,6 @@ class JobConfig:
             raise ValueError("chunk_bytes must be positive (or set num_chunks)")
         if self.top_k <= 0 or self.num_map_workers <= 0:
             raise ValueError("top_k and num_map_workers must be positive")
+        if self.kmeans_k <= 0 or self.kmeans_iters <= 0:
+            raise ValueError("kmeans_k and kmeans_iters must be positive")
         return self

@@ -28,6 +28,21 @@ def write_final_result(path: str, counts: Iterable[tuple[bytes, int]]) -> int:
     return n
 
 
+def write_postings(path: str, postings: dict[bytes, list[int]]) -> int:
+    """Inverted-index output: one ``term\\td1 d2 d3...\\n`` line per term,
+    terms byte-ascending, doc ids ascending — deterministic and atomic like
+    write_final_result.  Returns term count."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    n = 0
+    with open(tmp, "wb") as f:
+        for term in sorted(postings):
+            docs = b" ".join(str(d).encode() for d in postings[term])
+            f.write(term + b"\t" + docs + b"\n")
+            n += 1
+    os.replace(tmp, path)
+    return n
+
+
 def format_top_words(top: list[tuple[bytes, int]], k: int) -> str:
     """The reference's stdout report (main.rs:188-191): ``Top {k} words:``
     then ``{word}: {count}`` lines."""

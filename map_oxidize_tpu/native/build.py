@@ -98,6 +98,17 @@ def _load_lib():
         lib.moxt_map_range.restype = ctypes.c_int64
         lib.moxt_map_range.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                                        ctypes.c_int64, ctypes.c_int64]
+        lib.moxt_map_docs.restype = ctypes.c_int32
+        lib.moxt_map_docs.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                      ctypes.c_int64, ctypes.c_int64]
+        lib.moxt_pairs_n.restype = ctypes.c_int64
+        lib.moxt_pairs_n.argtypes = [ctypes.c_void_p]
+        lib.moxt_pairs_read.restype = None
+        lib.moxt_pairs_read.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                        ctypes.c_void_p]
+        lib.moxt_map_range_docs.restype = ctypes.c_int64
+        lib.moxt_map_range_docs.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                            ctypes.c_int64, ctypes.c_int64]
         _lib = lib
         return _lib
 
@@ -183,6 +194,62 @@ class NativeStream:
         finally:
             self._lib.moxt_file_close(f)
 
+    def _collect_pairs_locked(self) -> MapOutput:
+        n = int(self._lib.moxt_pairs_n(self._st))
+        n_tokens = int(self._lib.moxt_chunk_tokens(self._st))
+        hashes = np.empty(n, np.uint64)
+        docs = np.empty(n, np.int64)
+        if n:
+            self._lib.moxt_pairs_read(self._st, hashes.ctypes.data,
+                                      docs.ctypes.data)
+        d = self._drain_dict_locked()
+        hi, lo = split_u64(hashes)
+        # doc ids ride as two uint32 planes (the engine sorts 32-bit lanes)
+        du = docs.view(np.uint64)
+        vals = np.empty((n, 2), np.uint32)
+        vals[:, 0] = (du >> np.uint64(32)).astype(np.uint32)
+        vals[:, 1] = (du & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        return MapOutput(hi=hi, lo=lo, values=vals, dictionary=d,
+                         records_in=n_tokens)
+
+    def map_docs(self, chunk, base_doc: int = 0) -> MapOutput:
+        """Inverted-index map of one chunk: one row per distinct term per
+        document (doc id = ``base_doc`` + in-chunk line offset), values =
+        doc-id uint32 planes ``(n, 2)``."""
+        view = np.frombuffer(chunk, np.uint8)
+        with self._lock:
+            rc = self._lib.moxt_map_docs(self._st, view.ctypes.data,
+                                         view.size, base_doc)
+            if rc == 1:
+                raise ValueError("64-bit hash collision in native map")
+            if rc:
+                raise RuntimeError(f"native map_docs error {rc}")
+            return self._collect_pairs_locked()
+
+    def iter_file_docs(self, path: str, chunk_bytes: int):
+        """mmap inverted-index map over a file; doc ids are absolute byte
+        offsets of line starts.  Yields MapOutput per chunk."""
+        f = self._lib.moxt_file_open(os.fsencode(path))
+        if not f:
+            raise OSError(f"cannot open/mmap {path!r}")
+        try:
+            size = int(self._lib.moxt_file_size(f))
+            off = 0
+            while off < size:
+                with self._lock:
+                    consumed = int(self._lib.moxt_map_range_docs(
+                        self._st, f, off, chunk_bytes))
+                    if consumed == -1:
+                        raise ValueError("64-bit hash collision in native map")
+                    if consumed <= 0:
+                        raise RuntimeError(
+                            f"native map_range_docs error {consumed} at {off}")
+                    out = self._collect_pairs_locked()
+                off += consumed
+                yield out
+        finally:
+            self._lib.moxt_file_close(f)
+
     def _drain_dict_locked(self) -> HashDictionary:
         n = ctypes.c_int64()
         nbytes = ctypes.c_int64()
@@ -240,6 +307,12 @@ class StreamPool:
 
     def iter_file(self, path: str, chunk_bytes: int):
         return self.get().iter_file(path, chunk_bytes)
+
+    def map_docs(self, chunk, base_doc: int = 0) -> MapOutput:
+        return self.get().map_docs(chunk, base_doc)
+
+    def iter_file_docs(self, path: str, chunk_bytes: int):
+        return self.get().iter_file_docs(path, chunk_bytes)
 
     def close(self) -> None:
         with self._lock:

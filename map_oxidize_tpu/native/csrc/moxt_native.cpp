@@ -302,6 +302,21 @@ struct MoxtState {
   // last-chunk stats
   int64_t n_tokens = 0;
   int32_t error = 0;
+  // inverted-index mode: (term hash, doc id) pair emission buffers
+  uint64_t* pair_h = nullptr;
+  int64_t* pair_doc = nullptr;
+  int64_t pair_n = 0, pair_cap = 0;
+
+  void pair_push(uint64_t h, int64_t doc) {
+    if (pair_n == pair_cap) {
+      pair_cap = pair_cap ? pair_cap * 2 : 1 << 14;
+      pair_h = static_cast<uint64_t*>(realloc(pair_h, pair_cap * 8));
+      pair_doc = static_cast<int64_t*>(realloc(pair_doc, pair_cap * 8));
+    }
+    pair_h[pair_n] = h;
+    pair_doc[pair_n] = doc;
+    pair_n++;
+  }
 
   void log_push(uint64_t h, uint32_t len) {
     if (log_n == log_cap) {
@@ -315,41 +330,48 @@ struct MoxtState {
   }
 };
 
+// Insert one key into the persistent dictionary if novel, logging it for
+// the Python-side delta drain.  Detects cross-chunk 64-bit collisions.
+inline int dict_upsert(MoxtState* st, uint64_t h, uint64_t w0, uint64_t w1,
+                       uint32_t len, const uint8_t* bytes) {
+  Table& d = st->dict;
+  if (d.n * 2 >= d.cap) d.grow();
+  int64_t j = h & (d.cap - 1);
+  for (;;) {
+    Slot& t = d.slots[j];
+    if (t.count == 0) {
+      t.hash = h;
+      t.w0 = w0;
+      t.w1 = w1;
+      t.count = 1;
+      t.len = len;
+      t.aref = st->dict_arena.append(bytes, len);
+      t.epoch = 1;
+      d.n++;
+      st->log_push(h, len);
+      return UP_OK;
+    }
+    if (t.hash == h) {
+      if (t.len != len || t.w0 != w0 || t.w1 != w1 ||
+          (len > 16 &&
+           memcmp(st->dict_arena.data + t.aref, bytes, len) != 0))
+        return UP_COLLISION;
+      return UP_OK;  // already known
+    }
+    j = (j + 1) & (d.cap - 1);
+  }
+}
+
 // Insert the chunk table's live entries into the persistent dictionary
 // (novel keys only), logging them for the Python-side delta drain.
 inline int dict_absorb(MoxtState* st) {
-  Table& d = st->dict;
   const Table& c = st->chunk;
   for (int64_t i = 0; i < c.cap; i++) {
     const Slot& s = c.slots[i];
     if (s.epoch != c.epoch || s.count == 0) continue;
-    if (d.n * 2 >= d.cap) d.grow();
-    int64_t j = s.hash & (d.cap - 1);
-    for (;;) {
-      Slot& t = d.slots[j];
-      if (t.count == 0) {
-        t.hash = s.hash;
-        t.w0 = s.w0;
-        t.w1 = s.w1;
-        t.count = 1;
-        t.len = s.len;
-        t.aref = st->dict_arena.append(
-            st->chunk_arena.data + s.aref, s.len);
-        t.epoch = 1;
-        d.n++;
-        st->log_push(s.hash, s.len);
-        break;
-      }
-      if (t.hash == s.hash) {
-        if (t.len != s.len || t.w0 != s.w0 || t.w1 != s.w1 ||
-            (s.len > 16 &&
-             memcmp(st->dict_arena.data + t.aref,
-                    st->chunk_arena.data + s.aref, s.len) != 0))
-          return UP_COLLISION;  // cross-chunk 64-bit collision
-        break;                  // already known
-      }
-      j = (j + 1) & (d.cap - 1);
-    }
+    if (dict_upsert(st, s.hash, s.w0, s.w1, s.len,
+                    st->chunk_arena.data + s.aref) != UP_OK)
+      return UP_COLLISION;
   }
   return UP_OK;
 }
@@ -412,6 +434,8 @@ void moxt_free(MoxtState* st) {
   free(st->low);
   free(st->ws);
   free(st->key);
+  free(st->pair_h);
+  free(st->pair_doc);
   delete st;
 }
 
@@ -523,6 +547,114 @@ int32_t moxt_map(MoxtState* st, const uint8_t* data, int64_t len) {
 int64_t moxt_chunk_unique(MoxtState* st) { return st->chunk.n; }
 int64_t moxt_chunk_tokens(MoxtState* st) { return st->n_tokens; }
 
+// Inverted-index map: emit one (term hash, doc id) pair per DISTINCT term
+// per document, where a document is one line and its id is the absolute
+// byte offset of its first byte (base_doc + in-chunk offset) — unique,
+// monotone in document order, and derivable per chunk with no global line
+// counter.  Per-doc distinctness reuses the epoch trick: the chunk table
+// gets a fresh epoch per document, so "new this epoch" == "first time in
+// this doc".  Dictionary entries are inserted inline (the chunk table only
+// holds the current doc).  BASELINE.json config #4; generalizes the
+// reference's per-chunk HashMap (main.rs:94-101) to per-document key sets.
+int32_t moxt_map_docs(MoxtState* st, const uint8_t* data, int64_t len,
+                      int64_t base_doc) {
+  if (!st || st->error == 2) return 2;
+  if (st->ngram != 1) { st->error = 2; return 2; }
+  st->error = 0;
+  st->n_tokens = 0;
+  st->pair_n = 0;
+  st->chunk_arena.reset();
+  if (len <= 0) return 0;
+
+  if (len > st->scratch_cap) {
+    free(st->low);
+    free(st->ws);
+    st->low = static_cast<uint8_t*>(malloc(len + 64));
+    st->ws = static_cast<uint64_t*>(malloc((((len + 63) >> 6) + 2) * 8));
+    st->scratch_cap = len;
+  }
+  preprocess(data, len, st->low, st->ws);
+  const uint8_t* low = st->low;
+  const uint64_t* ws = st->ws;
+
+  int64_t n_tokens = 0;
+  int64_t pos = 0;
+  int64_t line_start = 0;   // in-chunk offset of the current doc's first byte
+  int64_t scanned = 0;      // newline search frontier
+  st->chunk.new_epoch();
+  while (true) {
+    int64_t start = next_clear(ws, pos);
+    if (start >= len) break;
+    // advance the current doc: last newline in [scanned, start) starts it
+    for (int64_t g = start - 1; g >= scanned; g--) {
+      if (data[g] == '\n') {
+        line_start = g + 1;
+        st->chunk.new_epoch();  // fresh per-doc distinct set
+        break;
+      }
+    }
+    scanned = start;
+    int64_t end = next_set(ws, start);
+    uint32_t tlen = (uint32_t)(end - start);
+    n_tokens++;
+    uint64_t w0, w1, h;
+    if (tlen <= 16) {
+      load16_masked(low + start, tlen, &w0, &w1);
+      h = moxt64_finish(moxt64_round((uint64_t)tlen * kM3, w0, w1));
+    } else {
+      load16_masked(low + start, 16, &w0, &w1);
+      h = moxt64(low + start, tlen);
+    }
+    // "new this doc" -> emit the pair and make sure the dict knows the term
+    Table& t = st->chunk;
+    if (t.n * 2 >= t.cap) t.grow();
+    int64_t mask = t.cap - 1;
+    int64_t j = h & mask;
+    bool fresh = false;
+    for (;;) {
+      Slot& s = t.slots[j];
+      if (s.epoch != t.epoch || s.count == 0) {
+        s.hash = h;
+        s.w0 = w0;
+        s.w1 = w1;
+        s.count = 1;
+        s.len = tlen;
+        s.aref = st->chunk_arena.append(low + start, tlen);
+        s.epoch = t.epoch;
+        t.n++;
+        fresh = true;
+        break;
+      }
+      if (s.hash == h) {
+        if (s.len == tlen && s.w0 == w0 && s.w1 == w1 &&
+            (tlen <= 16 ||
+             memcmp(st->chunk_arena.data + s.aref, low + start, tlen) == 0))
+          break;  // seen in this doc already: no pair
+        st->error = 1;
+        return 1;
+      }
+      j = (j + 1) & mask;
+    }
+    if (fresh) {
+      st->pair_push(h, base_doc + line_start);
+      if (dict_upsert(st, h, w0, w1, tlen, low + start) != UP_OK) {
+        st->error = 1;
+        return 1;
+      }
+    }
+    pos = end + 1;
+  }
+  st->n_tokens = n_tokens;
+  return 0;
+}
+
+int64_t moxt_pairs_n(MoxtState* st) { return st->pair_n; }
+
+void moxt_pairs_read(MoxtState* st, uint64_t* hashes, int64_t* docs) {
+  memcpy(hashes, st->pair_h, st->pair_n * 8);
+  memcpy(docs, st->pair_doc, st->pair_n * 8);
+}
+
 // Copy the chunk's compacted (hash, count) columns into caller buffers of
 // size moxt_chunk_unique().
 void moxt_chunk_read(MoxtState* st, uint64_t* hashes, int32_t* counts) {
@@ -605,6 +737,36 @@ int64_t moxt_map_range(MoxtState* st, MoxtFile* f, int64_t off, int64_t want) {
     if (cut >= 0) len = cut + 1;  // else: one giant token, hard cut at want
   }
   int32_t rc = moxt_map(st, f->data + off, len);
+  if (rc != 0) return -(int64_t)rc;
+  return len;
+}
+
+// mmap-range variant of moxt_map_docs; doc ids = absolute file offsets
+// because base_doc == off.  Cut policy differs from moxt_map_range on
+// purpose: doc identity requires every chunk to START at a line start, so a
+// window with no newline EXTENDS forward to the next one (a single document
+// longer than the window is carried whole — doc-mode residency is
+// O(longest line), which the workload inherently requires) instead of
+// falling back to a whitespace cut.
+int64_t moxt_map_range_docs(MoxtState* st, MoxtFile* f, int64_t off,
+                            int64_t want) {
+  if (!st || !f || off < 0 || off >= f->size || want <= 0) return 0;
+  int64_t len = f->size - off;
+  if (len > want) {
+    const uint8_t* p = f->data + off;
+    int64_t cut = -1;
+    for (int64_t i = want - 1; i >= 0; i--) {
+      if (p[i] == '\n') { cut = i; break; }
+    }
+    if (cut < 0) {
+      // no newline in the window: extend to the next one (or EOF)
+      for (int64_t i = want; i < len; i++) {
+        if (p[i] == '\n') { cut = i; break; }
+      }
+    }
+    len = (cut >= 0) ? cut + 1 : len;
+  }
+  int32_t rc = moxt_map_docs(st, f->data + off, len, off);
   if (rc != 0) return -(int64_t)rc;
   return len;
 }

@@ -46,6 +46,14 @@ def resolve_mapper(config: JobConfig, workload: str) -> str:
 
 def run_job(config: JobConfig, workload: str = "wordcount"):
     """Run a built-in workload end to end with the best available map path."""
+    if workload == "kmeans":
+        from map_oxidize_tpu.runtime.driver import run_kmeans_job
+
+        return run_kmeans_job(config)
+    if workload == "invertedindex":
+        from map_oxidize_tpu.runtime.driver import run_inverted_index_job
+
+        return run_inverted_index_job(config)
     mode = resolve_mapper(config, workload)
     if mode == "device":
         from map_oxidize_tpu.runtime.device_map import run_device_wordcount_job

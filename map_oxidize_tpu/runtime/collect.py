@@ -1,0 +1,119 @@
+"""CollectEngine: the variable-length-value reduce (SURVEY.md §7 hard part
+(d)).
+
+Word count's reduce is a monoid fold — values stay fixed-size, so an
+accumulator of reduced rows works (runtime/engine.py).  Inverted-index
+postings are the opposite: the "reduce" is list concatenation, and the
+per-key result size is unbounded.  The tensor-machine formulation is the one
+SURVEY §7 prescribes: collect ALL (key, doc) rows device-side, then ONE
+lexicographic sort by (key_hi, key_lo, doc_hi, doc_lo) at finalize — after
+which each key's postings list is a contiguous, internally-sorted segment.
+Segment boundaries fall out of a key-change scan on the host (vectorized
+diff, no Python loop), replacing the reference's single-mutex HashMap merge
+(/root/reference/src/main.rs:131-134) for a value type it never supported.
+
+Transfers are packed exactly like the streaming engine: each feed ships one
+``(4, B)`` uint32 array; finalize fetches one sorted ``(4, total)`` array
+(every distinct fetch on the measured link costs ~150 ms regardless of
+size).  Batches are padded with SENTINEL keys, which sort to the end and are
+truncated after the fetch.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from map_oxidize_tpu.api import MapOutput
+from map_oxidize_tpu.config import JobConfig
+from map_oxidize_tpu.ops.hashing import SENTINEL
+from map_oxidize_tpu.runtime.engine import next_pow2, pick_device
+from map_oxidize_tpu.utils.logging import get_logger
+
+_log = get_logger(__name__)
+
+
+@jax.jit
+def _sort_pairs(stacked):
+    """Sort a ``(4, N)`` packed pair block lexicographically by all four
+    planes (64-bit key then 64-bit doc id, in native 32-bit lanes)."""
+    hi, lo, dhi, dlo = stacked[0], stacked[1], stacked[2], stacked[3]
+    s = lax.sort((hi, lo, dhi, dlo), num_keys=4)
+    return jnp.stack(s)
+
+
+class CollectEngine:
+    """Append-only device collection of (key, doc) pairs + one final sort.
+
+    Feed path mirrors StreamingEngineBase's host staging (batched single-put
+    transfers); there is no reduction until finalize, so overflow semantics
+    are simply "HBM is the limit" — ``max_rows`` guards against a runaway
+    job eating the accelerator's memory."""
+
+    def __init__(self, config: JobConfig, device=None,
+                 max_rows: int = 1 << 27):
+        self.config = config
+        self.device = device if device is not None else pick_device(config.backend)
+        self.feed_batch = config.batch_size
+        self.max_rows = max_rows
+        self._batches: list = []   # device (4, B) blocks
+        self._batch_rows: list[int] = []  # live rows per block
+        self._stage: list = []
+        self._staged = 0
+        self.rows_fed = 0
+
+    def feed(self, out: MapOutput) -> None:
+        n = len(out)
+        self.rows_fed += n
+        if n == 0:
+            return
+        vals = out.values
+        if vals.ndim != 2 or vals.shape[1] != 2 or vals.dtype != np.uint32:
+            raise ValueError("CollectEngine expects (n, 2) uint32 doc planes")
+        self._stage.append((out.hi, out.lo, vals))
+        self._staged += n
+        if self.rows_fed > self.max_rows:
+            raise RuntimeError(
+                f"CollectEngine exceeded max_rows={self.max_rows}; "
+                f"shard the job or raise the limit")
+        if self._staged >= self.feed_batch:
+            self.flush()
+
+    def flush(self) -> None:
+        if not self._staged:
+            return
+        hi = np.concatenate([s[0] for s in self._stage])
+        lo = np.concatenate([s[1] for s in self._stage])
+        vals = np.concatenate([s[2] for s in self._stage])
+        self._stage = []
+        self._staged = 0
+        for start in range(0, hi.shape[0], self.feed_batch):
+            stop = min(start + self.feed_batch, hi.shape[0])
+            n = stop - start
+            b = min(next_pow2(max(n, 512)), self.feed_batch)
+            packed = np.full((4, b), SENTINEL, np.uint32)
+            packed[0, :n] = hi[start:stop]
+            packed[1, :n] = lo[start:stop]
+            packed[2, :n] = vals[start:stop, 0]
+            packed[3, :n] = vals[start:stop, 1]
+            self._batches.append(jax.device_put(packed, self.device))
+            self._batch_rows.append(n)
+
+    def finalize(self):
+        """One device sort over everything fed; returns host arrays
+        ``(keys_u64, docs_i64)`` sorted by (key, doc) with padding dropped."""
+        self.flush()
+        total = sum(self._batch_rows)
+        if total == 0:
+            return np.empty(0, np.uint64), np.empty(0, np.int64)
+        stacked = (self._batches[0] if len(self._batches) == 1
+                   else jnp.concatenate(self._batches, axis=1))
+        packed = np.asarray(_sort_pairs(stacked))[:, :total]
+        keys = (packed[0].astype(np.uint64) << np.uint64(32)) | packed[1]
+        docs = ((packed[2].astype(np.uint64) << np.uint64(32)) | packed[3]
+                ).view(np.int64)
+        return keys, docs

@@ -166,3 +166,142 @@ def run_wordcount_job(config: JobConfig, mapper: Mapper, reducer: Reducer) -> Jo
     if config.metrics:
         _log.info("metrics: %s", result.metrics)
     return result
+
+
+@dataclass
+class InvertedIndexResult:
+    """Postings plus metrics (the inverted-index analogue of JobResult)."""
+
+    postings: dict[bytes, list[int]]
+    metrics: dict = field(default_factory=dict)
+
+    def top_report(self, k: int) -> str:
+        top = sorted(self.postings.items(),
+                     key=lambda kv: (-len(kv[1]), kv[0]))[:k]
+        lines = [f"Top {k} terms by document frequency:"]
+        lines += [f"{t.decode('utf-8', 'replace')}: {len(d)} docs"
+                  for t, d in top]
+        return "\n".join(lines)
+
+
+def run_inverted_index_job(config: JobConfig) -> InvertedIndexResult:
+    """Inverted-index build (BASELINE config #4): map emits one (term, doc)
+    pair per distinct term per document; the CollectEngine sorts all pairs
+    once on device; postings fall out as contiguous segments.
+
+    Output file: one line per term, ``term\\td1 d2 d3...``, terms in byte
+    order — deterministic, unlike anything the reference's nondeterministic
+    HashMap ordering could produce (main.rs:170-182)."""
+    from map_oxidize_tpu.runtime.collect import CollectEngine
+    from map_oxidize_tpu.workloads.inverted_index import (
+        make_inverted_index,
+        postings_from_sorted,
+    )
+
+    config.validate()
+    metrics = Metrics()
+    mapper = make_inverted_index(config.tokenizer, config.use_native)
+    engine = CollectEngine(config)
+    dictionary = HashDictionary()
+    records_in = 0
+    n_chunks = 0
+    with metrics.phase("map+collect"):
+        _, chunk_bytes = plan_chunks(config.input_path, config.chunk_bytes)
+        it = mapper.iter_file_docs(config.input_path, chunk_bytes)
+        if it is None:
+            from map_oxidize_tpu.io.splitter import iter_doc_chunks
+
+            def _host_iter():
+                off = 0
+                for chunk in iter_doc_chunks(config.input_path, chunk_bytes):
+                    yield mapper.map_docs(chunk, off)
+                    off += len(chunk)
+            it = _host_iter()
+        for out in it:
+            dictionary.update(out.dictionary)
+            records_in += out.records_in
+            n_chunks += 1
+            engine.feed(out)
+
+    with metrics.phase("sort+postings"):
+        keys, docs = engine.finalize()
+        postings = postings_from_sorted(keys, docs, dictionary)
+
+    with metrics.phase("write"):
+        if config.output_path:
+            from map_oxidize_tpu.io.writer import write_postings
+
+            write_postings(config.output_path, postings)
+
+    metrics.set("records_in", records_in)
+    metrics.set("pairs", int(keys.shape[0]))
+    metrics.set("distinct_terms", len(postings))
+    metrics.set("chunks", n_chunks)
+    result = InvertedIndexResult(postings=postings, metrics=metrics.summary())
+    if config.metrics:
+        _log.info("metrics: %s", result.metrics)
+    return result
+
+
+@dataclass
+class KMeansResult:
+    """Final centroids plus per-phase metrics (the k-means analogue of
+    JobResult; there is no top-k or word dictionary to report)."""
+
+    centroids: np.ndarray
+    metrics: dict = field(default_factory=dict)
+
+    def top_report(self, k: int) -> str:  # CLI-facing summary
+        return (f"k-means: {self.centroids.shape[0]} centroids, "
+                f"dim {self.centroids.shape[1]}")
+
+
+def run_kmeans_job(config: JobConfig, centroids: np.ndarray | None = None
+                   ) -> KMeansResult:
+    """Streamed k-means (BASELINE config #5): ``kmeans_iters`` iterations of
+    map (host assign + per-chunk partial sums) -> device vector-sum reduce.
+
+    Input: a ``.npy`` float32 ``(n, d)`` points file, memory-mapped and
+    streamed by row ranges.  Initial centroids default to the first
+    ``kmeans_k`` points (deterministic)."""
+    from map_oxidize_tpu.api import SumReducer
+    from map_oxidize_tpu.workloads.kmeans import (
+        iter_point_chunks,
+        kmeans_iteration,
+    )
+
+    config.validate()
+    metrics = Metrics()
+    pts = np.load(config.input_path, mmap_mode="r")
+    if pts.ndim != 2:
+        raise ValueError(f"k-means input must be (n, d); got {pts.shape}")
+    n, d = pts.shape
+    if centroids is None:
+        centroids = np.asarray(pts[:config.kmeans_k], np.float32)
+    centroids = np.asarray(centroids, np.float32)
+    rows = max(1, config.chunk_bytes // (4 * d))
+    with metrics.phase("iterate"):
+        for _ in range(config.kmeans_iters):
+            engine = make_engine(config, SumReducer(),
+                                 value_shape=(d + 1,),
+                                 value_dtype=np.float32)
+            centroids = kmeans_iteration(
+                engine, centroids, iter_point_chunks(config.input_path, rows))
+    with metrics.phase("write"):
+        if config.output_path:
+            # write to the EXACT configured path (np.save(str) would append
+            # '.npy'), atomically like every other writer
+            import os
+
+            tmp = f"{config.output_path}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                np.save(f, centroids)
+            os.replace(tmp, config.output_path)
+    metrics.set("records_in", int(n) * config.kmeans_iters)
+    metrics.set("points", int(n))
+    metrics.set("dim", int(d))
+    metrics.set("iters", config.kmeans_iters)
+    result = KMeansResult(centroids=centroids, metrics=metrics.summary())
+    if config.metrics:
+        _log.info("metrics: %s", result.metrics)
+    return result

@@ -1,0 +1,127 @@
+"""Inverted-index build — BASELINE.json config #4 (no reference
+implementation exists; the reference's only workload is word count,
+/root/reference/src/main.rs:94-101).
+
+Semantics defined here:
+
+* a **document** is one line of the corpus;
+* its **doc id** is the absolute byte offset of its first byte — unique,
+  monotone in document order, and computable per chunk without a global
+  line counter (chunks are newline-aligned, so every chunk starts a doc);
+* the index maps each term (tokenized exactly like word count: whitespace
+  split + lowercase, main.rs:96-97) to the ascending list of ids of the
+  documents that contain it at least once.
+
+This is the variable-length-value reduce word count cannot express: the
+combine is list concatenation, handled by runtime/collect.CollectEngine
+(collect all (term, doc) pairs, ONE device sort, segment boundaries on the
+host).  The map side emits one pair per distinct term per document — the
+native path (moxt_map_docs) reuses the epoch-table trick for the per-doc
+distinct set.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from map_oxidize_tpu.api import Mapper, MapOutput
+from map_oxidize_tpu.ops.hashing import HashDictionary, moxt64_bytes, split_u64
+from map_oxidize_tpu.workloads.wordcount import tokenize
+
+
+class InvertedIndexMapper(Mapper):
+    """(chunk bytes, base byte offset) -> one (term-hash, doc-id) row per
+    distinct term per document.  Values are the doc id's uint32 planes."""
+
+    value_shape = (2,)
+    value_dtype = np.uint32
+    keys_have_dictionary = True
+
+    def __init__(self, tokenizer: str = "ascii", use_native: bool = True):
+        self.tokenizer = tokenizer
+        self._native = None
+        if use_native and tokenizer == "ascii":
+            from map_oxidize_tpu.native import bindings
+
+            self._native = bindings.stream_or_none(ngram=1)
+
+    def map_docs(self, chunk, base_doc: int = 0) -> MapOutput:
+        if self._native is not None:
+            return self._native.map_docs(chunk, base_doc)
+        return self._map_docs_python(chunk, base_doc)
+
+    def iter_file_docs(self, path: str, chunk_bytes: int):
+        """Native mmap fast path, or None (driver falls back to the
+        splitter + map_docs with host-tracked offsets)."""
+        if self._native is None:
+            return None
+        return self._native.iter_file_docs(path, chunk_bytes)
+
+    def map_chunk(self, chunk) -> MapOutput:  # Mapper ABC
+        raise NotImplementedError(
+            "InvertedIndexMapper needs the chunk's base byte offset for doc "
+            "identity — use map_docs(chunk, base_doc) or the "
+            "run_inverted_index_job driver, not the offset-less map path")
+
+    def _map_docs_python(self, chunk, base_doc: int) -> MapOutput:
+        chunk = bytes(chunk)
+        d = HashDictionary()
+        hashes: list[int] = []
+        docs: list[int] = []
+        n_tokens = 0
+        off = 0
+        for line in chunk.split(b"\n"):
+            toks = tokenize(line, self.tokenizer)
+            n_tokens += len(toks)
+            seen = set()
+            for t in toks:
+                if t not in seen:
+                    seen.add(t)
+                    h = moxt64_bytes(t)
+                    d.add(h, t)
+                    hashes.append(h)
+                    docs.append(base_doc + off)
+            off += len(line) + 1
+        h64 = np.array(hashes, np.uint64)
+        hi, lo = split_u64(h64)
+        du = np.array(docs, np.uint64)
+        vals = np.empty((len(docs), 2), np.uint32)
+        vals[:, 0] = (du >> np.uint64(32)).astype(np.uint32)
+        vals[:, 1] = (du & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        return MapOutput(hi=hi, lo=lo, values=vals, dictionary=d,
+                         records_in=n_tokens)
+
+
+def inverted_index_model(path: str) -> dict[bytes, list[int]]:
+    """Pure-host oracle: {term: ascending doc-id list}, doc id = line start
+    byte offset.  Independent of every engine and mapper under test."""
+    index: dict[bytes, set[int]] = {}
+    off = 0
+    with open(path, "rb") as f:
+        for line in f:
+            for t in tokenize(line):
+                index.setdefault(t, set()).add(off)
+            off += len(line)
+    return {t: sorted(s) for t, s in index.items()}
+
+
+def postings_from_sorted(keys: np.ndarray, docs: np.ndarray,
+                         dictionary: HashDictionary) -> dict[bytes, list[int]]:
+    """Sorted (key, doc) rows -> {term bytes: doc-id list}.  Boundary
+    detection is a vectorized diff, no per-row Python.  (term, doc) pairs
+    are unique by construction: the mapper emits each term once per doc and
+    docs never straddle chunks — newline-aligned cuts guarantee it."""
+    if keys.shape[0] == 0:
+        return {}
+    out: dict[bytes, list[int]] = {}
+    bounds = np.flatnonzero(np.concatenate(
+        [[True], keys[1:] != keys[:-1]]))
+    bounds = np.append(bounds, keys.shape[0])
+    for i in range(bounds.shape[0] - 1):
+        a, b = int(bounds[i]), int(bounds[i + 1])
+        out[dictionary.lookup(int(keys[a]))] = docs[a:b].tolist()
+    return out
+
+
+def make_inverted_index(tokenizer: str = "ascii", use_native: bool = True):
+    return InvertedIndexMapper(tokenizer, use_native)

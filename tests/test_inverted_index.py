@@ -1,0 +1,111 @@
+"""Inverted-index workload (BASELINE config #4): native and Python mappers
+vs the pure-host oracle, end-to-end job parity, postings file format."""
+
+import numpy as np
+import pytest
+
+from map_oxidize_tpu.config import JobConfig
+from map_oxidize_tpu.native.bindings import load_or_none
+from map_oxidize_tpu.runtime import run_job
+from map_oxidize_tpu.runtime.driver import run_inverted_index_job
+from map_oxidize_tpu.workloads.inverted_index import (
+    InvertedIndexMapper,
+    inverted_index_model,
+)
+
+native = load_or_none()
+
+CORPUS = (b"the cat sat on the mat\n"
+          b"the DOG ran\n"
+          b"\n"
+          b"cat cat cat dog\n"
+          b"punct, stays! the cat.\n"
+          b"tabs\tand spaces  mixed\n")
+
+
+def _write(tmp_path, data=CORPUS):
+    p = tmp_path / "docs.txt"
+    p.write_bytes(data)
+    return str(p)
+
+
+def _job_postings(path, **kw):
+    cfg = JobConfig(input_path=path, output_path="", backend="cpu",
+                    metrics=False, **kw)
+    return run_inverted_index_job(cfg).postings
+
+
+def test_job_matches_oracle(tmp_path):
+    p = _write(tmp_path)
+    assert _job_postings(p) == inverted_index_model(p)
+
+
+def test_multi_chunk_matches_single(tmp_path):
+    p = _write(tmp_path)
+    whole = _job_postings(p)
+    chunked = _job_postings(p, chunk_bytes=32)
+    assert whole == chunked == inverted_index_model(p)
+
+
+@pytest.mark.skipif(native is None, reason="native build unavailable")
+def test_python_mapper_matches_native(tmp_path):
+    p = _write(tmp_path)
+    py = InvertedIndexMapper(use_native=False).map_docs(CORPUS, 0)
+    nat = InvertedIndexMapper(use_native=True).map_docs(CORPUS, 0)
+
+    def rows(out):
+        k = (out.hi.astype(np.uint64) << np.uint64(32)) | out.lo
+        d = (out.values[:, 0].astype(np.uint64) << np.uint64(32)) \
+            | out.values[:, 1]
+        return sorted(zip(k.tolist(), d.tolist()))
+
+    assert rows(py) == rows(nat)
+    assert dict(py.dictionary.items()) == dict(nat.dictionary.items())
+    assert py.records_in == nat.records_in
+
+
+def test_base_doc_offsets(tmp_path):
+    # doc ids are absolute byte offsets: shifting base shifts every id
+    out0 = InvertedIndexMapper(use_native=False).map_docs(b"a b\nc a\n", 0)
+    out9 = InvertedIndexMapper(use_native=False).map_docs(b"a b\nc a\n", 9)
+    d0 = sorted((out0.values[:, 1]).tolist())
+    d9 = sorted((out9.values[:, 1]).tolist())
+    assert [x + 9 for x in d0] == d9
+
+
+def test_empty_and_blank_docs(tmp_path):
+    p = _write(tmp_path, b"\n\n\nword\n\n")
+    post = _job_postings(p)
+    assert post == {b"word": [3]}
+    empty = _write(tmp_path, b"")
+    assert _job_postings(empty) == {}
+
+
+def test_postings_file_roundtrip(tmp_path):
+    p = _write(tmp_path)
+    outp = tmp_path / "postings.txt"
+    cfg = JobConfig(input_path=p, output_path=str(outp), backend="cpu",
+                    metrics=False)
+    res = run_job(cfg, "invertedindex")
+    lines = outp.read_bytes().decode().strip().split("\n")
+    assert len(lines) == len(res.postings)
+    got = {}
+    for ln in lines:
+        term, docs = ln.split("\t")
+        got[term.encode()] = [int(x) for x in docs.split()]
+    assert got == res.postings
+    # deterministic: re-run byte-identical
+    before = outp.read_bytes()
+    run_job(cfg, "invertedindex")
+    assert outp.read_bytes() == before
+
+
+def test_larger_random_corpus(tmp_path, rng):
+    words = [bytes(rng.choice(list(b"abcdeXY,."),
+                              size=rng.integers(1, 9))) for _ in range(80)]
+    lines = []
+    for _ in range(400):
+        k = rng.integers(0, 12)
+        lines.append(b" ".join(words[i] for i in rng.integers(0, 80, size=k)))
+    p = _write(tmp_path, b"\n".join(lines) + b"\n")
+    assert _job_postings(p, chunk_bytes=257) == inverted_index_model(p)

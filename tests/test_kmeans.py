@@ -1,0 +1,114 @@
+"""k-means workload (BASELINE config #5): streamed engine result == NumPy
+oracle, device-resident path == oracle, conservation, empty clusters."""
+
+import numpy as np
+import pytest
+
+from map_oxidize_tpu.api import SumReducer
+from map_oxidize_tpu.config import JobConfig
+from map_oxidize_tpu.runtime import run_job
+from map_oxidize_tpu.runtime.driver import make_engine, run_kmeans_job
+from map_oxidize_tpu.workloads.kmeans import (
+    KMeansMapper,
+    assign_points,
+    iter_point_chunks,
+    kmeans_fit_device,
+    kmeans_iteration,
+    kmeans_model,
+)
+
+
+def _blobs(rng, n=4000, d=8, k=5):
+    centers = rng.normal(0, 10, size=(k, d)).astype(np.float32)
+    pts = (centers[rng.integers(0, k, size=n)]
+           + rng.normal(0, 0.5, size=(n, d))).astype(np.float32)
+    return pts, centers
+
+
+def test_streamed_iteration_matches_oracle(rng):
+    pts, init = _blobs(rng)
+    cfg = JobConfig(input_path="unused", output_path="", backend="cpu",
+                    batch_size=512, metrics=False)
+    engine = make_engine(cfg, SumReducer(), value_shape=(pts.shape[1] + 1,),
+                         value_dtype=np.float32)
+    chunks = [pts[i:i + 700] for i in range(0, pts.shape[0], 700)]
+    ours = kmeans_iteration(engine, init, chunks)
+    want = kmeans_model(pts, init)
+    np.testing.assert_allclose(ours, want, rtol=1e-4, atol=1e-4)
+
+
+def test_device_fit_matches_oracle(rng):
+    pts, init = _blobs(rng, n=2000, d=4, k=3)
+    got = kmeans_fit_device(pts, init, iters=1)
+    want = kmeans_model(pts, init)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+
+def test_device_fit_multi_iter_matches_repeated_oracle(rng):
+    pts, init = _blobs(rng, n=1500, d=4, k=4)
+    got = kmeans_fit_device(pts, init, iters=3)
+    want = init
+    for _ in range(3):
+        want = kmeans_model(pts, want)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+
+def test_empty_centroid_keeps_position(rng):
+    pts = np.ones((50, 2), np.float32)          # all points at (1, 1)
+    init = np.array([[1.0, 1.0], [99.0, 99.0]], np.float32)
+    cfg = JobConfig(input_path="unused", output_path="", backend="cpu",
+                    metrics=False)
+    engine = make_engine(cfg, SumReducer(), value_shape=(3,),
+                         value_dtype=np.float32)
+    new = kmeans_iteration(engine, init, [pts])
+    np.testing.assert_allclose(new[0], [1.0, 1.0])
+    np.testing.assert_allclose(new[1], [99.0, 99.0])  # empty: unchanged
+
+
+def test_mapper_emits_partial_sums(rng):
+    pts, init = _blobs(rng, n=300, d=3, k=4)
+    out = KMeansMapper(init).map_chunk(pts)
+    assert out.records_in == 300
+    # counts column conserves points
+    assert int(round(float(out.values[:, -1].sum()))) == 300
+    # each emitted row matches a direct per-centroid sum
+    cid = assign_points(pts, init)
+    for hi, lo, row in zip(out.hi, out.lo, out.values):
+        assert hi == 0
+        m = cid == int(lo)
+        np.testing.assert_allclose(row[:-1], pts[m].sum(0), rtol=1e-4)
+        assert int(round(float(row[-1]))) == int(m.sum())
+
+
+def test_run_kmeans_job_end_to_end(tmp_path, rng):
+    pts, _ = _blobs(rng, n=3000, d=6, k=4)
+    inp = tmp_path / "points.npy"
+    np.save(inp, pts)
+    outp = tmp_path / "centroids.npy"
+    cfg = JobConfig(input_path=str(inp), output_path=str(outp),
+                    backend="cpu", kmeans_k=4, kmeans_iters=2,
+                    chunk_bytes=4096, metrics=False)
+    res = run_job(cfg, "kmeans")
+    want = np.asarray(pts[:4], np.float32)
+    for _ in range(2):
+        want = kmeans_model(pts, want)
+    np.testing.assert_allclose(res.centroids, want, rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(np.load(outp), res.centroids)
+
+
+def test_conservation_violation_raises(rng):
+    # a mapper bug that miscounts points must be caught by the count check
+    pts, init = _blobs(rng, n=100, d=2, k=2)
+    cfg = JobConfig(input_path="unused", output_path="", backend="cpu",
+                    metrics=False)
+
+    class Lossy(KMeansMapper):
+        def map_chunk(self, points):
+            out = super().map_chunk(points)
+            out.records_in += 7  # claim more points than were summed
+            return out
+
+    engine = make_engine(cfg, SumReducer(), value_shape=(3,),
+                         value_dtype=np.float32)
+    with pytest.raises(RuntimeError, match="conservation"):
+        kmeans_iteration(engine, init, [pts], mapper=Lossy(init))
