@@ -62,6 +62,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="k-means iterations")
     p.add_argument("--checkpoint-dir", default=None,
                    help="directory for resumable map-output checkpoints")
+    p.add_argument("--trace-dir", default=None,
+                   help="capture a jax.profiler trace of the run into this "
+                        "directory (TensorBoard-compatible)")
     p.add_argument("--keep-intermediates", action="store_true")
     p.add_argument("-v", "--verbose", action="store_true")
     p.add_argument("-q", "--quiet", action="store_true")
@@ -86,6 +89,7 @@ def config_from_args(args: argparse.Namespace) -> JobConfig:
         use_native=not args.no_native,
         checkpoint_dir=args.checkpoint_dir,
         keep_intermediates=args.keep_intermediates,
+        trace_dir=args.trace_dir,
         kmeans_k=args.kmeans_k,
         kmeans_iters=args.kmeans_iters,
     ).validate()
@@ -103,10 +107,13 @@ def main(argv: list[str] | None = None) -> int:
     if not os.path.isfile(config.input_path):
         print(f"error: cannot open input {config.input_path!r}", file=sys.stderr)
         return 2
-    for flag, val in (("--checkpoint-dir", config.checkpoint_dir),
-                      ("--keep-intermediates", config.keep_intermediates)):
-        if val:
-            _log.warning("%s is not wired into the runtime yet; ignoring", flag)
+    if config.keep_intermediates and not config.checkpoint_dir:
+        _log.warning("--keep-intermediates has no effect without "
+                     "--checkpoint-dir (there are no intermediates: map "
+                     "outputs stay on device)")
+    if config.checkpoint_dir and args.workload in ("kmeans", "invertedindex"):
+        _log.warning("--checkpoint-dir is only wired for wordcount/bigram; "
+                     "%s runs without checkpointing", args.workload)
 
     from map_oxidize_tpu.runtime import run_job
 

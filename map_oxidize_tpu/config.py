@@ -58,6 +58,8 @@ class JobConfig:
     #: per-chunk map retry budget (reference: abort on first error,
     #: main.rs:88 `handle.await??`)
     max_retries: int = 2
+    #: jax.profiler trace output directory; None disables trace capture
+    trace_dir: str | None = None
     #: use the C++ native tokenizer when available
     use_native: bool = True
     #: emit per-phase timing/throughput metrics

@@ -19,8 +19,13 @@ import os
 from typing import Iterator
 
 
-def iter_chunks(path: str, chunk_bytes: int) -> Iterator[bytes]:
+def iter_chunks(path: str, chunk_bytes: int,
+                start_offset: int = 0) -> Iterator[bytes]:
     """Yield newline-aligned chunks of AT MOST ``chunk_bytes`` each.
+
+    ``start_offset`` resumes mid-file: it must be a previous run's chunk
+    boundary (a cut point), in which case the yielded chunks are identical to
+    the tail of a fresh run's — the checkpoint/resume contract.
 
     Yields ``memoryview``s over per-chunk buffers filled with ``readinto`` —
     one kernel->user copy per byte, no re-slicing copies (the map hot loop
@@ -38,7 +43,9 @@ def iter_chunks(path: str, chunk_bytes: int) -> Iterator[bytes]:
     """
     with open(path, "rb", buffering=0) as f:
         size = os.fstat(f.fileno()).st_size
-        off = 0      # bytes yielded so far
+        off = start_offset   # absolute offset of the next unconsumed byte
+        if start_offset:
+            f.seek(start_offset)
         carry = b""
         while off < size:
             want = min(chunk_bytes, size - off)

@@ -168,17 +168,20 @@ class NativeStream:
         return MapOutput(hi=hi, lo=lo, values=counts, dictionary=d,
                          records_in=records)
 
-    def iter_file(self, path: str, chunk_bytes: int):
+    def iter_file(self, path: str, chunk_bytes: int, start_offset: int = 0):
         """Map a file via the C++ mmap path: zero kernel->user copies, chunk
         cuts chosen in C (last newline, then last whitespace, then hard cut —
         the same bounded-carry policy as io.splitter.iter_chunks).  Yields
-        MapOutput per chunk."""
+        ``(MapOutput, next_offset)`` per chunk; ``start_offset`` resumes at a
+        previous run's cut boundary (checkpoint/resume contract: the cut
+        policy is deterministic in (offset, chunk_bytes), so the resumed
+        chunk stream is identical to a fresh run's tail)."""
         f = self._lib.moxt_file_open(os.fsencode(path))
         if not f:
             raise OSError(f"cannot open/mmap {path!r}")
         try:
             size = int(self._lib.moxt_file_size(f))
-            off = 0
+            off = start_offset
             while off < size:
                 with self._lock:
                     consumed = int(self._lib.moxt_map_range(
@@ -190,7 +193,7 @@ class NativeStream:
                             f"native map_range error {consumed} at {off}")
                     out = self._collect_locked(0, drain_dict=True)
                 off += consumed
-                yield out
+                yield out, off
         finally:
             self._lib.moxt_file_close(f)
 
@@ -305,8 +308,8 @@ class StreamPool:
     def map_chunk(self, chunk) -> MapOutput:
         return self.get().map_chunk(chunk)
 
-    def iter_file(self, path: str, chunk_bytes: int):
-        return self.get().iter_file(path, chunk_bytes)
+    def iter_file(self, path: str, chunk_bytes: int, start_offset: int = 0):
+        return self.get().iter_file(path, chunk_bytes, start_offset)
 
     def map_docs(self, chunk, base_doc: int = 0) -> MapOutput:
         return self.get().map_docs(chunk, base_doc)

@@ -72,7 +72,13 @@ def _exchange(hi, lo, vals, num_shards: int, cap: int):
     counts = jnp.bincount(dest, length=S)
     starts = jnp.cumsum(counts) - counts
     rank = idx - jnp.take(starts, dest_s)  # position within the bucket
-    overflow = jnp.sum(jnp.maximum(counts - cap, 0))
+    # overflow counts only REAL rows against cap: the pre-combine compacts
+    # real rows ahead of the padding tail, so within each bucket (stable sort
+    # by dest) real rows occupy the lowest ranks and any dropped tail is
+    # padding unless the bucket's *real* count exceeds cap.  Counting pads
+    # too would abort correct runs whose dropped tail was padding only.
+    real_counts = jnp.bincount(jnp.where(is_pad, S, dest), length=S)
+    overflow = jnp.sum(jnp.maximum(real_counts - cap, 0))
 
     # scatter into the [S, cap] send buffer; rank >= cap rows are dropped
     # (mode='drop') and accounted for by `overflow`

@@ -45,7 +45,18 @@ def resolve_mapper(config: JobConfig, workload: str) -> str:
 
 
 def run_job(config: JobConfig, workload: str = "wordcount"):
-    """Run a built-in workload end to end with the best available map path."""
+    """Run a built-in workload end to end with the best available map path.
+
+    With ``config.trace_dir`` set, the whole job runs under a
+    ``jax.profiler`` trace (device timeline + host events) written there —
+    the deep-dive companion to the always-on phase wall-clocks."""
+    from map_oxidize_tpu.utils.profiling import jax_trace
+
+    with jax_trace(config.trace_dir):
+        return _run_job(config, workload)
+
+
+def _run_job(config: JobConfig, workload: str):
     if workload == "kmeans":
         from map_oxidize_tpu.runtime.driver import run_kmeans_job
 
@@ -73,4 +84,4 @@ def run_job(config: JobConfig, workload: str = "wordcount"):
         mapper, reducer = make_bigram(config.tokenizer, use_native)
     else:
         raise ValueError(f"unknown workload {workload!r}")
-    return run_wordcount_job(config, mapper, reducer)
+    return run_wordcount_job(config, mapper, reducer, workload=workload)

@@ -1,0 +1,208 @@
+"""Map-output checkpointing: resumable jobs.
+
+The reference's intermediate files (``map_{w}_chunk_{i}.txt``,
+``/root/reference/src/main.rs:74-75``) are a de-facto materialization barrier
+that a resume *could* exploit — but the reference has no resume logic and
+deletes them unconditionally (main.rs:194-202).  This module makes the
+barrier real and useful: with ``checkpoint_dir`` set, every mapped chunk's
+``MapOutput`` (key planes, values, dictionary delta) is spilled atomically,
+and a re-run of the same job replays the spilled prefix into the device
+engine instead of re-mapping it, then resumes mapping at the recorded byte
+offset.
+
+Layout under ``checkpoint_dir``:
+
+* ``meta.json`` — job identity (input path/size/mtime, chunk_bytes, workload,
+  tokenizer).  A mismatch invalidates the checkpoint (it is discarded and the
+  job starts fresh) — resuming someone else's intermediates must be
+  impossible.
+* ``chunk_{i:06d}.npz`` — one per mapped chunk, written to a temp name and
+  renamed, so a killed run can never leave a torn chunk file.  Carries
+  ``next_offset``: the input byte offset after this chunk, which is a valid
+  restart point by the splitter/native cut contract (both cut at the same
+  whitespace boundaries).
+
+Only the **contiguous** prefix ``chunk_0 .. chunk_{k-1}`` is replayed; later
+files (possible when threaded map completes out of order) are discarded and
+re-mapped.  The dictionary deltas replay in order, so collision detection
+(`HashDictionary.add`) behaves exactly as live.
+
+``keep_intermediates=True`` preserves the directory after success (the
+reference's cleanup always deletes, main.rs:194-202; a failure to delete is a
+warning there and here).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+import numpy as np
+
+from map_oxidize_tpu.api import MapOutput
+from map_oxidize_tpu.ops.hashing import HashDictionary
+from map_oxidize_tpu.utils.logging import get_logger
+
+_log = get_logger(__name__)
+
+_FORMAT_VERSION = 1
+
+
+def _dict_to_arrays(d: HashDictionary):
+    """hash->bytes dict as (hashes u64, lens i64, blob u8) arrays."""
+    hashes = np.fromiter((h for h, _ in d.items()), np.uint64, count=len(d))
+    toks = [t for _, t in d.items()]
+    lens = np.fromiter((len(t) for t in toks), np.int64, count=len(toks))
+    blob = np.frombuffer(b"".join(toks), np.uint8) if toks else np.empty(0, np.uint8)
+    return hashes, lens, blob
+
+
+def _arrays_to_dict(hashes, lens, blob) -> HashDictionary:
+    d = HashDictionary()
+    mv = blob.tobytes()
+    off = 0
+    for h, n in zip(hashes.tolist(), lens.tolist()):
+        d.add(int(h), mv[off:off + n])
+        off += n
+    return d
+
+
+class CheckpointStore:
+    """Spill/replay of per-chunk map outputs under one directory."""
+
+    def __init__(self, directory: str, meta: dict):
+        self.dir = directory
+        self.meta = dict(meta, version=_FORMAT_VERSION)
+        os.makedirs(self.dir, exist_ok=True)
+        self._meta_path = os.path.join(self.dir, "meta.json")
+        existing = self._read_meta()
+        if existing is not None and existing != self.meta:
+            _log.warning(
+                "checkpoint at %s is for a different job "
+                "(have %s, want %s); discarding it", self.dir, existing,
+                self.meta)
+            self._clear_chunks(strict=True)
+            existing = None
+        if existing is None:
+            self._clear_chunks(strict=True)
+            tmp = self._meta_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(self.meta, f, sort_keys=True)
+            os.replace(tmp, self._meta_path)
+
+    @staticmethod
+    def job_meta(config, workload: str) -> dict:
+        """The identity key a checkpoint must match to be resumable."""
+        st = os.stat(config.input_path)
+        return {
+            "input_path": os.path.abspath(config.input_path),
+            "input_size": st.st_size,
+            "input_mtime_ns": st.st_mtime_ns,
+            "chunk_bytes": config.chunk_bytes,
+            "num_chunks": config.num_chunks,
+            "workload": workload,
+            "tokenizer": config.tokenizer,
+        }
+
+    def _read_meta(self) -> dict | None:
+        try:
+            with open(self._meta_path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _chunk_path(self, idx: int) -> str:
+        return os.path.join(self.dir, f"chunk_{idx:06d}.npz")
+
+    def _clear_chunks(self, strict: bool = False) -> None:
+        """Remove all checkpoint artifacts.  ``strict`` raises if a stale
+        chunk file survives — required when invalidating another job's spill,
+        where a leftover chunk would later replay as if it were ours (the
+        'resuming someone else's intermediates' corruption this module
+        promises is impossible)."""
+        failed = []
+        for name in os.listdir(self.dir):
+            if (name.startswith("chunk_") or name.startswith("meta.json")
+                    or name.endswith(".tmp")):
+                try:
+                    os.unlink(os.path.join(self.dir, name))
+                except OSError as e:
+                    failed.append((name, e))
+        if failed and strict:
+            raise RuntimeError(
+                f"cannot invalidate stale checkpoint in {self.dir}: "
+                f"{failed[0][1]} (and {len(failed) - 1} more); remove the "
+                "directory manually or choose another checkpoint_dir")
+
+    # --- spill ----------------------------------------------------------
+
+    def save(self, idx: int, out: MapOutput, next_offset: int) -> None:
+        """Atomically persist one mapped chunk (torn files impossible: temp
+        file + rename; a crash between the two leaves only the temp)."""
+        hashes, lens, blob = _dict_to_arrays(out.dictionary)
+        fd, tmp = tempfile.mkstemp(suffix=".npz.tmp", dir=self.dir)
+        try:
+            with os.fdopen(fd, "wb") as f:
+                np.savez(
+                    f,
+                    hi=out.hi, lo=out.lo, values=out.values,
+                    records_in=np.int64(out.records_in),
+                    next_offset=np.int64(next_offset),
+                    dict_hashes=hashes, dict_lens=lens, dict_blob=blob,
+                )
+            os.replace(tmp, self._chunk_path(idx))
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    # --- replay ---------------------------------------------------------
+
+    def saved_prefix(self) -> int:
+        """Number of chunks in the contiguous saved prefix (0 = nothing)."""
+        k = 0
+        while os.path.isfile(self._chunk_path(k)):
+            k += 1
+        return k
+
+    def replay(self):
+        """Yield ``(idx, MapOutput, next_offset)`` for the contiguous prefix;
+        stale out-of-order leftovers beyond it are deleted (they will be
+        re-mapped, so keeping them could only confuse a later resume)."""
+        k = self.saved_prefix()
+        for name in os.listdir(self.dir):
+            if name.startswith("chunk_") and name.endswith(".npz"):
+                try:
+                    idx = int(name[6:12])
+                except ValueError:
+                    continue
+                if idx >= k:
+                    os.unlink(os.path.join(self.dir, name))
+        for idx in range(k):
+            with np.load(self._chunk_path(idx)) as z:
+                out = MapOutput(
+                    hi=z["hi"], lo=z["lo"], values=z["values"],
+                    dictionary=_arrays_to_dict(
+                        z["dict_hashes"], z["dict_lens"], z["dict_blob"]),
+                    records_in=int(z["records_in"]),
+                )
+                yield idx, out, int(z["next_offset"])
+
+    # --- lifecycle ------------------------------------------------------
+
+    def finish(self, keep: bool) -> None:
+        """On job success: delete the spill unless ``keep_intermediates``.
+        Deletion failures warn and continue, like the reference's cleanup
+        (main.rs:197-198)."""
+        if keep:
+            _log.info("keeping %d checkpoint chunks in %s",
+                      self.saved_prefix(), self.dir)
+            return
+        try:
+            self._clear_chunks()
+            os.rmdir(self.dir)
+        except OSError as e:
+            _log.warning("could not remove checkpoint dir %s: %s", self.dir, e)

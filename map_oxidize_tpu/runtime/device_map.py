@@ -89,13 +89,19 @@ class _DictBuilder:
         d = self.dictionary
         rl = rep.astype(np.int64).tolist()
         for i, h in enumerate(h64):
-            if d.get(h) is None:
-                d.add(h, token_at(chunk, rl[i]))
+            # unconditional add: on a repeat hash this compares the stored
+            # bytes against this chunk's representative token, so a 64-bit
+            # device-hash collision (two tokens, one hash) raises here just
+            # as it would on the host paths instead of silently merging
+            d.add(h, token_at(chunk, rl[i]))
 
 
 def run_device_wordcount_job(config: JobConfig) -> JobResult:
     """Word count with the map phase on device (single chip)."""
     config.validate()
+    if config.checkpoint_dir:
+        _log.warning("checkpointing is not wired for the device map path; "
+                     "running without (use mapper='native' to checkpoint)")
     metrics = Metrics()
     engine = DeviceReduceEngine(config, SumReducer())
     tok = DeviceTokenizer(config.chunk_bytes, config.device_chunk_keys,

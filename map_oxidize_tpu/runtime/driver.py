@@ -89,54 +89,106 @@ def _readback(engine: StreamingEngineBase, dictionary: HashDictionary):
     return out
 
 
-def run_wordcount_job(config: JobConfig, mapper: Mapper, reducer: Reducer) -> JobResult:
-    """End-to-end word-count-shaped job (scalar sum values, string keys)."""
+def _track_offsets(chunk_iter, start_off: int, offsets: dict, base_idx: int):
+    """Pass chunks through, recording each one's absolute end offset keyed by
+    global chunk index — chunks from ``iter_chunks`` are contiguous consumed
+    byte ranges, so the end offset is the running sum of lengths."""
+    off = start_off
+    for i, mv in enumerate(chunk_iter):
+        off += len(mv)
+        offsets[base_idx + i] = off
+        yield mv
+
+
+def run_wordcount_job(config: JobConfig, mapper: Mapper, reducer: Reducer,
+                      workload: str = "wordcount") -> JobResult:
+    """End-to-end word-count-shaped job (scalar sum values, string keys).
+
+    With ``config.checkpoint_dir`` set, every mapped chunk is spilled
+    atomically and a re-run replays the spilled prefix instead of re-mapping
+    it (see :mod:`map_oxidize_tpu.runtime.checkpoint`)."""
     config.validate()
     metrics = Metrics()
 
-    # --- split (plan only; chunks stream lazily — contrast main.rs:16/36-51)
-    native_file_iter = None
-    with metrics.phase("split"):
-        if config.num_chunks > 0:
-            chunks = split_round_robin(config.input_path, config.num_chunks)
-        else:
-            _, chunk_bytes = plan_chunks(config.input_path, config.chunk_bytes)
-            # native mmap fast path: C++ scans page-cache pages in place
-            # (zero kernel->user copies) and owns the chunk cuts
-            if hasattr(mapper, "map_file"):
-                native_file_iter = mapper.map_file(config.input_path,
-                                                   chunk_bytes)
-            if native_file_iter is not None:
-                _log.debug(
-                    "native mmap map path: chunks map inline in C++; "
-                    "num_map_workers/max_retries do not apply (a map error "
-                    "here is a hash collision, which no retry can fix)")
-            else:
-                chunks = iter_chunks(config.input_path, chunk_bytes)
-
-    # --- map + reduce, fused streaming phase (main.rs:19-22 were barriered)
     engine = make_engine(config, reducer,
                          value_shape=mapper.value_shape,
                          value_dtype=mapper.value_dtype)
     dictionary = HashDictionary()
     records_in = 0
     n_chunks = 0
+
+    def _ingest(out) -> None:
+        nonlocal records_in, n_chunks
+        dictionary.update(out.dictionary)
+        records_in += out.records_in
+        n_chunks += 1
+        if mapper.keys_have_dictionary:
+            # the dictionary covers every key fed so far, so its size is
+            # an exact distinct-key bound — growth needs no device sync
+            engine.hint_total_keys(len(dictionary))
+        engine.feed(out)
+
+    # --- replay checkpointed chunks (resume), if any
+    ckpt = None
+    resume_k = 0      # chunks already mapped in a previous run
+    resume_off = 0    # input byte offset where mapping resumes
+    if config.checkpoint_dir:
+        from map_oxidize_tpu.runtime.checkpoint import CheckpointStore
+
+        ckpt = CheckpointStore(config.checkpoint_dir,
+                               CheckpointStore.job_meta(config, workload))
+        with metrics.phase("replay"):
+            for idx, out, next_off in ckpt.replay():
+                _ingest(out)
+                resume_k, resume_off = idx + 1, next_off
+        if resume_k:
+            _log.info("resumed %d checkpointed chunks%s", resume_k,
+                      f" (input offset {resume_off})" if resume_off >= 0
+                      else " (round-robin mode)")
+        resume_off = max(resume_off, 0)  # -1 = round-robin: offsets unused
+
+    # --- split (plan only; chunks stream lazily — contrast main.rs:16/36-51)
+    native_file_iter = None
+    offsets: dict[int, int] = {}  # global chunk idx -> end byte offset
+    with metrics.phase("split"):
+        if config.num_chunks > 0:
+            # round-robin compat mode: chunk identity is the index, not a
+            # byte offset — resume skips the first resume_k chunks
+            chunks = split_round_robin(config.input_path,
+                                       config.num_chunks)[resume_k:]
+        else:
+            _, chunk_bytes = plan_chunks(config.input_path, config.chunk_bytes)
+            # native mmap fast path: C++ scans page-cache pages in place
+            # (zero kernel->user copies) and owns the chunk cuts
+            if hasattr(mapper, "map_file"):
+                native_file_iter = mapper.map_file(config.input_path,
+                                                   chunk_bytes, resume_off)
+            if native_file_iter is not None:
+                _log.debug(
+                    "native mmap map path: chunks map inline in C++; "
+                    "num_map_workers/max_retries do not apply (a map error "
+                    "here is a hash collision, which no retry can fix)")
+            else:
+                chunks = _track_offsets(
+                    iter_chunks(config.input_path, chunk_bytes, resume_off),
+                    resume_off, offsets, resume_k)
+
+    # --- map + reduce, fused streaming phase (main.rs:19-22 were barriered)
     with metrics.phase("map+reduce"):
         if native_file_iter is not None:
-            outputs = enumerate(native_file_iter)
+            for i, (out, next_off) in enumerate(native_file_iter):
+                _ingest(out)
+                if ckpt is not None:
+                    ckpt.save(resume_k + i, out, next_off)
         else:
             outputs = run_map_phase(
                 chunks, mapper, config.num_map_workers, config.max_retries
             )
-        for _idx, out in outputs:
-            dictionary.update(out.dictionary)
-            records_in += out.records_in
-            n_chunks += 1
-            if mapper.keys_have_dictionary:
-                # the dictionary covers every key fed so far, so its size is
-                # an exact distinct-key bound — growth needs no device sync
-                engine.hint_total_keys(len(dictionary))
-            engine.feed(out)
+            for idx, out in outputs:
+                _ingest(out)
+                if ckpt is not None:
+                    gidx = resume_k + idx
+                    ckpt.save(gidx, out, offsets.get(gidx, -1))
 
     # --- finalize on device; read back to host strings
     with metrics.phase("finalize"):
@@ -157,6 +209,11 @@ def run_wordcount_job(config: JobConfig, mapper: Mapper, reducer: Reducer) -> Jo
     with metrics.phase("write"):
         if config.output_path:
             write_final_result(config.output_path, counts.items())
+
+    # --- cleanup (reference: main.rs:194-202 always deletes; here
+    # keep_intermediates preserves the resumable spill)
+    if ckpt is not None:
+        ckpt.finish(config.keep_intermediates)
 
     metrics.set("records_in", records_in)
     metrics.set("distinct_keys", len(counts))
@@ -199,6 +256,9 @@ def run_inverted_index_job(config: JobConfig) -> InvertedIndexResult:
     )
 
     config.validate()
+    if config.checkpoint_dir:
+        _log.warning("checkpointing is not wired for invertedindex; "
+                     "running without")
     metrics = Metrics()
     mapper = make_inverted_index(config.tokenizer, config.use_native)
     engine = CollectEngine(config)
@@ -271,12 +331,18 @@ def run_kmeans_job(config: JobConfig, centroids: np.ndarray | None = None
     )
 
     config.validate()
+    if config.checkpoint_dir:
+        _log.warning("checkpointing is not wired for kmeans; running without")
     metrics = Metrics()
     pts = np.load(config.input_path, mmap_mode="r")
     if pts.ndim != 2:
         raise ValueError(f"k-means input must be (n, d); got {pts.shape}")
     n, d = pts.shape
     if centroids is None:
+        if n < config.kmeans_k:
+            raise ValueError(
+                f"k-means needs at least kmeans_k={config.kmeans_k} points "
+                f"to init centroids; input has {n}")
         centroids = np.asarray(pts[:config.kmeans_k], np.float32)
     centroids = np.asarray(centroids, np.float32)
     rows = max(1, config.chunk_bytes // (4 * d))

@@ -37,11 +37,11 @@ class BigramMapper(Mapper):
 
             self._native = bindings.stream_or_none(ngram=2)
 
-    def map_file(self, path: str, chunk_bytes: int):
+    def map_file(self, path: str, chunk_bytes: int, start_offset: int = 0):
         """Native mmap fast path (see WordCountMapper.map_file)."""
         if self._native is None:
             return None
-        return self._native.iter_file(path, chunk_bytes)
+        return self._native.iter_file(path, chunk_bytes, start_offset)
 
     def map_chunk(self, chunk: bytes) -> MapOutput:
         if self._native is not None:

@@ -1,0 +1,157 @@
+"""Checkpoint/resume: a job killed mid-map resumes without re-mapping the
+spilled prefix and produces byte-identical output.
+
+The reference's intermediate files (main.rs:74-75) could have supported this
+but nothing reads them across runs; here resume is a tested contract
+(VERDICT round 1, item 7)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from map_oxidize_tpu.config import JobConfig
+from map_oxidize_tpu.runtime import run_job
+from map_oxidize_tpu.runtime.checkpoint import CheckpointStore
+from map_oxidize_tpu.workloads.wordcount import WordCountMapper
+
+
+def _make_corpus(path, n_lines=4000, seed=0):
+    rng = np.random.default_rng(seed)
+    words = [b"alpha", b"beta", b"Gamma,", b"delta.", b"epsilon", b"zeta"]
+    with open(path, "wb") as f:
+        for _ in range(n_lines):
+            k = int(rng.integers(3, 9))
+            f.write(b" ".join(words[int(i)] for i in rng.integers(0, 6, k)))
+            f.write(b"\n")
+
+
+class _DyingMapper(WordCountMapper):
+    """Aborts the run after ``die_after`` chunks — the mid-run kill."""
+
+    def __init__(self, die_after: int, **kw):
+        super().__init__(**kw)
+        self.mapped = 0
+        self.die_after = die_after
+
+    def map_chunk(self, chunk):
+        if self.mapped >= self.die_after:
+            raise KeyboardInterrupt("simulated kill")
+        self.mapped += 1
+        return super().map_chunk(chunk)
+
+
+def _cfg(corpus, out, ckdir, **kw):
+    base = dict(
+        input_path=str(corpus), output_path=str(out), checkpoint_dir=ckdir,
+        chunk_bytes=16 * 1024, backend="cpu", num_shards=1, metrics=False,
+        num_map_workers=1, max_retries=0, use_native=False, mapper="python",
+    )
+    base.update(kw)
+    return JobConfig(**base)
+
+
+@pytest.mark.parametrize("use_native", [False, True])
+def test_resume_after_kill_byte_identical(tmp_path, use_native):
+    corpus = tmp_path / "corpus.txt"
+    _make_corpus(corpus)
+    ckdir = str(tmp_path / "ck")
+    mapper_mode = "native" if use_native else "python"
+
+    # reference run: no checkpointing at all
+    want_out = tmp_path / "want.txt"
+    run_job(_cfg(corpus, want_out, None, mapper=mapper_mode,
+                 use_native=use_native), "wordcount")
+
+    # run 1: dies mid-map.  The python mapper path is used for the kill run
+    # (the native mmap path maps inline in C++ — a per-chunk kill hook needs
+    # map_chunk), so spilled chunks come from the splitter path; the resume
+    # run may then use either path, proving the two agree on chunk cuts.
+    from map_oxidize_tpu.runtime.driver import run_wordcount_job
+    from map_oxidize_tpu.api import SumReducer
+
+    dying = _DyingMapper(die_after=3, use_native=False)
+    got_out = tmp_path / "got.txt"
+    with pytest.raises(KeyboardInterrupt):
+        run_wordcount_job(_cfg(corpus, got_out, ckdir), dying, SumReducer())
+    saved = [n for n in os.listdir(ckdir) if n.endswith(".npz")]
+    assert len(saved) == 3, saved
+
+    # run 2: resumes — must not re-map the spilled prefix
+    counting = _DyingMapper(die_after=10**9, use_native=use_native)
+    if use_native and counting._native is None:
+        pytest.skip("native build unavailable")
+    res = run_wordcount_job(
+        _cfg(corpus, got_out, ckdir, mapper=mapper_mode,
+             use_native=use_native), counting, SumReducer())
+    total_chunks = res.metrics["chunks"]
+    if not use_native:
+        assert counting.mapped == total_chunks - 3  # prefix was replayed
+
+    assert got_out.read_bytes() == want_out.read_bytes()
+    # success removes the spill by default (reference cleanup semantics)
+    assert not os.path.isdir(ckdir)
+
+
+def test_keep_intermediates_preserves_spill(tmp_path):
+    corpus = tmp_path / "corpus.txt"
+    _make_corpus(corpus, n_lines=500)
+    ckdir = str(tmp_path / "ck")
+    run_job(_cfg(corpus, tmp_path / "o.txt", ckdir, keep_intermediates=True),
+            "wordcount")
+    names = os.listdir(ckdir)
+    assert "meta.json" in names
+    assert any(n.endswith(".npz") for n in names)
+
+    # a second identical run replays everything and still matches
+    res = run_job(_cfg(corpus, tmp_path / "o2.txt", ckdir,
+                       keep_intermediates=True), "wordcount")
+    assert (tmp_path / "o.txt").read_bytes() == (tmp_path / "o2.txt").read_bytes()
+    assert res.metrics["chunks"] > 0
+
+
+def test_checkpoint_invalidated_on_different_job(tmp_path):
+    corpus = tmp_path / "corpus.txt"
+    _make_corpus(corpus, n_lines=500)
+    other = tmp_path / "other.txt"
+    _make_corpus(other, n_lines=700, seed=1)
+    ckdir = str(tmp_path / "ck")
+
+    run_job(_cfg(corpus, tmp_path / "o.txt", ckdir, keep_intermediates=True),
+            "wordcount")
+    # same dir, different input: stale spill must be discarded, not replayed
+    res = run_job(_cfg(other, tmp_path / "o2.txt", ckdir), "wordcount")
+    want = run_job(_cfg(other, tmp_path / "o3.txt", None), "wordcount")
+    assert res.counts == want.counts
+
+
+def test_round_robin_mode_resumes_by_index(tmp_path):
+    corpus = tmp_path / "corpus.txt"
+    _make_corpus(corpus, n_lines=800)
+    ckdir = str(tmp_path / "ck")
+    from map_oxidize_tpu.runtime.driver import run_wordcount_job
+    from map_oxidize_tpu.api import SumReducer
+
+    want = run_job(_cfg(corpus, tmp_path / "w.txt", None, num_chunks=6),
+                   "wordcount")
+    dying = _DyingMapper(die_after=2, use_native=False)
+    with pytest.raises(KeyboardInterrupt):
+        run_wordcount_job(_cfg(corpus, tmp_path / "g.txt", ckdir,
+                               num_chunks=6), dying, SumReducer())
+    counting = _DyingMapper(die_after=10**9, use_native=False)
+    res = run_wordcount_job(_cfg(corpus, tmp_path / "g.txt", ckdir,
+                                 num_chunks=6), counting, SumReducer())
+    assert counting.mapped == 4  # 6 chunks, 2 replayed
+    assert res.counts == want.counts
+
+
+def test_meta_mismatch_detection(tmp_path):
+    corpus = tmp_path / "c.txt"
+    _make_corpus(corpus, n_lines=100)
+    cfg = _cfg(corpus, "", str(tmp_path / "ck"))
+    m1 = CheckpointStore.job_meta(cfg, "wordcount")
+    m2 = CheckpointStore.job_meta(cfg, "bigram")
+    assert m1 != m2
+    m3 = CheckpointStore.job_meta(
+        _cfg(corpus, "", None, chunk_bytes=8 * 1024), "wordcount")
+    assert m1 != m3

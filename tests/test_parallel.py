@@ -125,6 +125,42 @@ def test_skewed_batch_no_overflow(rng):
     assert got == _truth(keys, vals)
 
 
+def test_padding_does_not_count_toward_bucket_overflow():
+    """Regression (ADVICE r1): a mostly-padding batch must not trip
+    ShuffleOverflowError.  8 distinct keys land one per bucket, but the
+    512-row padded batch spreads ~8 round-robin pads into each 3-slot
+    bucket; only REAL rows may count against cap — the dropped tail here is
+    padding only, and no data is lost."""
+    cfg = JobConfig(batch_size=512, key_capacity=4096, backend="cpu",
+                    num_shards=8)
+    eng = ShardedReduceEngine(cfg, SumReducer(), bucket_cap=3)
+    keys = np.arange(8, dtype=np.uint64)  # bucket_of = (hi^lo)%8 -> 1 each
+    vals = np.full(8, 5, np.int32)
+    hi = (keys >> np.uint64(32)).astype(np.uint32)
+    lo = (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    eng.feed(MapOutput(hi=hi, lo=lo, values=vals, dictionary=HashDictionary()))
+    got, n = _readback(eng)   # finalize health-checks: old code raised here
+    assert got == _truth(keys, vals)
+    assert n == 8
+
+
+def test_real_bucket_overflow_still_raises():
+    """The counter must still catch real drops: 8 distinct keys forced into
+    ONE bucket with cap=3 loses rows, which must raise, not silently drop."""
+    from map_oxidize_tpu.parallel.engine import ShuffleOverflowError
+
+    cfg = JobConfig(batch_size=512, key_capacity=4096, backend="cpu",
+                    num_shards=8)
+    eng = ShardedReduceEngine(cfg, SumReducer(), bucket_cap=3)
+    keys = (np.arange(8, dtype=np.uint64) << np.uint64(3))  # (hi^lo)%8 == 0
+    vals = np.ones(8, np.int32)
+    hi = (keys >> np.uint64(32)).astype(np.uint32)
+    lo = (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    eng.feed(MapOutput(hi=hi, lo=lo, values=vals, dictionary=HashDictionary()))
+    with pytest.raises(ShuffleOverflowError):
+        eng.finalize()
+
+
 def test_topk_wider_than_shard_capacity(rng):
     """k > per-shard capacity must not silently truncate: each shard's whole
     accumulator is gathered, so up to min(k, S*cap) rows come back."""
