@@ -52,8 +52,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tokenizer", choices=["ascii", "unicode"], default="ascii")
     p.add_argument("--mapper", choices=["auto", "device", "native", "python"],
                    default="auto",
-                   help="map-phase placement: TPU kernel, C++ host loop, or "
-                        "pure Python (auto: device on accelerator)")
+                   help="map-phase placement: TPU kernel (single or sharded), "
+                        "C++ host loop, or pure Python (auto: native — the "
+                        "measured winner on a remote-attached chip)")
     p.add_argument("--no-native", action="store_true",
                    help="disable the C++ tokenizer hot loop")
     p.add_argument("--kmeans-k", type=int, default=16,

@@ -174,26 +174,89 @@ def _dedup_chunk(t_hi, t_lo, t_start, out_keys: int):
             reps[:out_keys], n_unique, n_dropped)
 
 
-@partial(jax.jit, static_argnames=("max_tokens", "out_keys", "fetch_keys"))
-def tokenize_count_chunk(chunk, pk1, pki1, pk2, pki2,
-                         max_tokens: int, out_keys: int, fetch_keys: int):
-    """Fused device map for one chunk: bytes -> per-unique-key
-    ``(hi, lo, count, rep_start)`` plus ``(n_unique, n_dropped, n_tokens)``
-    and ``packed`` — one uint32 array carrying the scalars and the first
-    ``fetch_keys`` (hi, lo, rep) rows, so the host's dictionary update is a
-    single transfer instead of four.
+#: odd mixing multipliers for composing adjacent token hashes into an n-gram
+#: key (uint32 wraparound; golden-ratio and murmur-style constants)
+_NG1 = 0x9E3779B1
+_NG2 = 0xC2B2AE35
+
+
+def _ngram_rows(t_hi, t_lo, t_start, n_tokens, ngram: int):
+    """Compose token rows into n-gram rows: row j covers tokens
+    ``[j, j+ngram)`` (in-chunk adjacency, same semantics as the host bigram
+    mapper — pairs never straddle chunks).
+
+    The n-gram key mixes the member tokens' two hash planes with odd
+    multipliers; host-side dictionary building recovers the exact string via
+    the representative start offset (:func:`ngram_at` re-tokenizes the span),
+    and the dictionary's byte-compare turns any mixing collision into an
+    error rather than a silent merge.
     """
+    if ngram == 1:
+        return t_hi, t_lo, t_start, n_tokens
+    m = t_hi.shape[0]
+    g_hi, g_lo = t_hi, t_lo
+    for k in range(1, ngram):
+        nxt_hi = jnp.concatenate([t_hi[k:], jnp.full(k, SENTINEL, jnp.uint32)])
+        nxt_lo = jnp.concatenate([t_lo[k:], jnp.full(k, SENTINEL, jnp.uint32)])
+        g_hi = g_hi * jnp.uint32(_NG1) + nxt_hi
+        g_lo = g_lo * jnp.uint32(_NG2) + nxt_lo
+    n_grams = jnp.maximum(n_tokens - (ngram - 1), 0)
+    live = jnp.arange(m, dtype=jnp.int32) < n_grams
+    g_hi = jnp.where(live, g_hi, jnp.uint32(SENTINEL))
+    g_lo = jnp.where(live, g_lo, jnp.uint32(SENTINEL))
+    g_start = jnp.where(live, t_start, jnp.iinfo(jnp.int32).max)
+    # padding guard: a live n-gram must never alias the SENTINEL pair
+    both = (g_hi == jnp.uint32(SENTINEL)) & (g_lo == jnp.uint32(SENTINEL))
+    g_lo = jnp.where(live & both, jnp.uint32(SENTINEL - 1), g_lo)
+    return g_hi, g_lo, g_start, n_grams
+
+
+def tokenize_count_core(chunk, pk1, pki1, pk2, pki2,
+                        max_tokens: int, out_keys: int, fetch_keys: int,
+                        ngram: int = 1):
+    """Unjitted kernel body — also the per-shard body of the sharded device
+    map (under ``shard_map`` each shard runs exactly this over its own
+    chunk)."""
     h1, h2, tok_start, _, end = tokenize_hash(chunk, pk1, pki1, pk2, pki2)
     t_hi, t_lo, t_start, n_tokens = _compact_tokens(
         h1, h2, tok_start, end, max_tokens)
+    t_hi, t_lo, t_start, n_records = _ngram_rows(
+        t_hi, t_lo, t_start, n_tokens, ngram)
     u_hi, u_lo, counts, reps, n_unique, n_dropped = _dedup_chunk(
         t_hi, t_lo, t_start, out_keys)
     f = fetch_keys
     packed = jnp.concatenate([
-        jnp.stack([n_unique, n_dropped, n_tokens]).astype(jnp.uint32),
+        jnp.stack([n_unique, n_dropped, n_records]).astype(jnp.uint32),
         u_hi[:f], u_lo[:f], reps[:f].astype(jnp.uint32),
     ])
     return u_hi, u_lo, counts, reps, packed
+
+
+@partial(jax.jit,
+         static_argnames=("max_tokens", "out_keys", "fetch_keys", "ngram"))
+def tokenize_count_chunk(chunk, pk1, pki1, pk2, pki2,
+                         max_tokens: int, out_keys: int, fetch_keys: int,
+                         ngram: int = 1):
+    """Fused device map for one chunk: bytes -> per-unique-key
+    ``(hi, lo, count, rep_start)`` plus ``(n_unique, n_dropped, n_records)``
+    and ``packed`` — one uint32 array carrying the scalars and the first
+    ``fetch_keys`` (hi, lo, rep) rows, so the host's dictionary update is a
+    single transfer instead of four.  ``ngram > 1`` counts in-chunk adjacent
+    token n-grams instead of single tokens.
+    """
+    return tokenize_count_core(chunk, pk1, pki1, pk2, pki2, max_tokens,
+                               out_keys, fetch_keys, ngram)
+
+
+def pad_chunk(chunk: bytes, n: int) -> np.ndarray:
+    """Chunk bytes -> the kernel's fixed [n] uint8 window, space-padded
+    (spaces yield no tokens, so no valid-length scalar rides along)."""
+    if len(chunk) > n:
+        raise ValueError(f"chunk of {len(chunk)} bytes exceeds {n}")
+    arr = np.frombuffer(chunk, np.uint8)
+    if len(chunk) < n:
+        arr = np.concatenate([arr, np.full(n - len(chunk), 32, np.uint8)])
+    return arr
 
 
 class DeviceTokenizer:
@@ -204,31 +267,34 @@ class DeviceTokenizer:
     """
 
     def __init__(self, chunk_bytes: int, out_keys: int = 1 << 19,
-                 device=None, fetch_keys: int = 1 << 16):
+                 device=None, fetch_keys: int = 1 << 16, ngram: int = 1):
         self.n = chunk_bytes
         self.max_tokens = chunk_bytes // 2 + 1
-        self.out_keys = out_keys
-        self.fetch_keys = min(fetch_keys, out_keys)
+        # the kernel can emit at most max_tokens unique rows; out_keys beyond
+        # that would desync the host's packed-array slicing from the kernel's
+        # actual (clamped) output width
+        self.out_keys = min(out_keys, self.max_tokens)
+        self.fetch_keys = min(fetch_keys, self.out_keys)
         self.device = device
+        self.ngram = ngram
         pk1, pki1, pk2, pki2 = _power_tables(self.n)
         put = (lambda x: jax.device_put(x, device)) if device else jax.device_put
         self._tables = tuple(put(t) for t in (pk1, pki1, pk2, pki2))
+
+    def pad_chunk(self, chunk: bytes) -> np.ndarray:
+        return pad_chunk(chunk, self.n)
 
     def map_chunk_device(self, chunk: bytes):
         """Returns device arrays ``(u_hi, u_lo, counts, reps, packed)`` for
         one chunk of at most ``chunk_bytes`` (``packed``: scalars + first
         ``fetch_keys`` dictionary rows in one fetchable array)."""
-        if len(chunk) > self.n:
-            raise ValueError(f"chunk of {len(chunk)} bytes exceeds {self.n}")
-        arr = np.frombuffer(chunk, np.uint8)
-        if len(chunk) < self.n:
-            arr = np.concatenate(
-                [arr, np.full(self.n - len(chunk), 32, np.uint8)])
+        arr = self.pad_chunk(chunk)
         dev = jax.device_put(arr, self.device) if self.device else \
             jax.device_put(arr)
         return tokenize_count_chunk(
             dev, *self._tables, max_tokens=self.max_tokens,
-            out_keys=self.out_keys, fetch_keys=self.fetch_keys)
+            out_keys=self.out_keys, fetch_keys=self.fetch_keys,
+            ngram=self.ngram)
 
 
 def token_at(chunk: bytes, start: int) -> bytes:
@@ -241,3 +307,24 @@ def token_at(chunk: bytes, start: int) -> bytes:
     while end < n and chunk[end] not in ws:
         end += 1
     return chunk[start:end].lower()
+
+
+def ngram_at(chunk: bytes, start: int, ngram: int) -> bytes:
+    """The canonical n-gram string whose first token starts at ``start``:
+    member tokens joined by ONE space (the host mappers' key format —
+    ``"tok1 tok2"`` — regardless of the whitespace actually between them)."""
+    if ngram == 1:
+        return token_at(chunk, start)
+    ws = b" \t\n\r\x0b\x0c"
+    n = len(chunk)
+    toks = []
+    pos = start
+    for _ in range(ngram):
+        end = pos
+        while end < n and chunk[end] not in ws:
+            end += 1
+        toks.append(chunk[pos:end].lower())
+        pos = end
+        while pos < n and chunk[pos] in ws:
+            pos += 1
+    return b" ".join(toks)

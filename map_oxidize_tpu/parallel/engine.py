@@ -96,11 +96,24 @@ class ShardedReduceEngine(StreamingEngineBase):
         self._acc = list(self._grow(*self._acc, new_cap - self.capacity))
 
     def _merge_batch(self, padded) -> None:
-        incoming = self._incoming(padded[0].shape[0])
-        self._ensure_capacity(incoming)
         batch = jax.device_put(padded, self._sharding)
+        self.feed_device(*batch, count_rows=False)
+
+    def feed_device(self, hi, lo, vals, count_rows: bool = True) -> None:
+        """Merge a device-resident batch already sharded over the mesh (row
+        count divisible by S) — the hand-off used by the sharded on-device
+        map path: tokenized rows flow from the shard_map tokenizer straight
+        into the all_to_all exchange with no host round trip."""
+        if hi.shape[0] % self.S:
+            raise ValueError(
+                f"sharded feed_device needs S|rows; got {hi.shape[0]} rows "
+                f"for {self.S} shards")
+        incoming = self._incoming(hi.shape[0])
+        self._ensure_capacity(incoming)
+        if count_rows:
+            self.rows_fed += hi.shape[0]
         *self._acc, self._n_unique, self._overflow = self._merge(
-            *self._acc, self._overflow, *batch
+            *self._acc, self._overflow, hi, lo, vals
         )
         self._n_live_ub += incoming
 

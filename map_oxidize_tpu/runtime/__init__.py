@@ -24,7 +24,7 @@ def resolve_mapper(config: JobConfig, workload: str) -> str:
     mode = config.mapper
     if mode == "auto":
         mode = "native"
-    if mode == "device" and workload not in ("wordcount",):
+    if mode == "device" and workload not in ("wordcount", "bigram"):
         _log.info("device mapper does not implement %r yet; using native",
                   workload)
         mode = "native"
@@ -32,15 +32,6 @@ def resolve_mapper(config: JobConfig, workload: str) -> str:
         _log.info("device mapper is ascii-only; using native for %r",
                   config.tokenizer)
         mode = "native"
-    if mode == "device":
-        # effective shard count: 0 means "all visible devices"
-        from map_oxidize_tpu.runtime.driver import effective_num_shards
-
-        n = effective_num_shards(config)
-        if n > 1:
-            _log.info("device mapper is single-chip for now; using native "
-                      "for %d shards", n)
-            mode = "native"
     return mode
 
 
@@ -67,9 +58,16 @@ def _run_job(config: JobConfig, workload: str):
         return run_inverted_index_job(config)
     mode = resolve_mapper(config, workload)
     if mode == "device":
-        from map_oxidize_tpu.runtime.device_map import run_device_wordcount_job
+        from map_oxidize_tpu.runtime.device_map import (
+            run_device_wordcount_job,
+            run_sharded_device_job,
+        )
+        from map_oxidize_tpu.runtime.driver import effective_num_shards
 
-        return run_device_wordcount_job(config)
+        ngram = 2 if workload == "bigram" else 1
+        if effective_num_shards(config) > 1:
+            return run_sharded_device_job(config, ngram)
+        return run_device_wordcount_job(config, ngram)
 
     from map_oxidize_tpu.runtime.driver import run_wordcount_job
 

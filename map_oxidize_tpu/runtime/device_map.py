@@ -26,9 +26,13 @@ from map_oxidize_tpu.api import SumReducer
 from map_oxidize_tpu.config import JobConfig
 from map_oxidize_tpu.io.splitter import iter_chunks_capped
 from map_oxidize_tpu.io.writer import write_final_result
-from map_oxidize_tpu.ops.device_tokenize import DeviceTokenizer, token_at
+from map_oxidize_tpu.ops.device_tokenize import (
+    DeviceTokenizer,
+    ngram_at,
+    pad_chunk,
+)
 from map_oxidize_tpu.ops.hashing import HashDictionary
-from map_oxidize_tpu.runtime.driver import JobResult, _readback
+from map_oxidize_tpu.runtime.driver import JobResult, _readback, _top_k
 from map_oxidize_tpu.runtime.engine import (
     CapacityError,
     DeviceReduceEngine,
@@ -57,15 +61,32 @@ class _DictBuilder:
     chunk — fetch latency is the remote-device tax, so one is the budget.
     """
 
-    def __init__(self, out_keys: int, fetch_keys: int):
+    def __init__(self, out_keys: int, fetch_keys: int, ngram: int = 1):
         self.dictionary = HashDictionary()
         self.out_keys = out_keys
         self.fetch_keys = min(fetch_keys, out_keys)
         self.records_in = 0
+        self.ngram = ngram
 
     def process(self, chunk: bytes, outs) -> None:
         u_hi, u_lo, counts, reps, packed_dev = outs
         packed = np.asarray(packed_dev)  # THE one blocking fetch per chunk
+        self.process_packed(
+            chunk, packed,
+            lambda nu: self._fetch_overflow(u_hi, u_lo, reps, nu))
+
+    def _fetch_overflow(self, u_hi, u_lo, reps, nu: int):
+        """Rare path: per-chunk novelty exceeded the pre-packed window, so
+        the full (hi, lo, rep) prefix must be fetched separately."""
+        m = min(next_pow2(nu), self.out_keys)
+        over = np.asarray(_prefix_packer(m)(u_hi, u_lo, reps))
+        return over[0][:nu], over[1][:nu], over[2][:nu]
+
+    def process_packed(self, chunk: bytes, packed: np.ndarray,
+                       fetch_overflow) -> None:
+        """Update the dictionary from one already-fetched packed row (the
+        sharded path fetches a whole group's [S, ...] packed array at once
+        and calls this per shard)."""
         nu, ndrop, ntok = packed[:3].astype(np.int64).tolist()
         if ndrop:
             raise CapacityError(
@@ -81,23 +102,154 @@ class _DictBuilder:
                            packed[3 + f:3 + f + nu],
                            packed[3 + 2 * f:3 + 2 * f + nu])
         else:  # rare: more novelty than the pre-packed window
-            m = min(next_pow2(nu), self.out_keys)
-            over = np.asarray(_prefix_packer(m)(u_hi, u_lo, reps))
-            hi, lo, rep = over[0][:nu], over[1][:nu], over[2][:nu]
+            hi, lo, rep = fetch_overflow(nu)
         h64 = ((hi.astype(np.uint64) << np.uint64(32))
                | lo.astype(np.uint64)).tolist()
         d = self.dictionary
         rl = rep.astype(np.int64).tolist()
+        ng = self.ngram
         for i, h in enumerate(h64):
             # unconditional add: on a repeat hash this compares the stored
             # bytes against this chunk's representative token, so a 64-bit
             # device-hash collision (two tokens, one hash) raises here just
             # as it would on the host paths instead of silently merging
-            d.add(h, token_at(chunk, rl[i]))
+            d.add(h, ngram_at(chunk, rl[i], ng))
 
 
-def run_device_wordcount_job(config: JobConfig) -> JobResult:
-    """Word count with the map phase on device (single chip)."""
+def run_sharded_device_job(config: JobConfig, ngram: int = 1) -> JobResult:
+    """Word/n-gram count with the map phase on device across a mesh.
+
+    Chunks are dealt round-robin onto shards in groups of S; one
+    ``device_put`` ships the group as a ``[S * chunk_bytes]`` byte array
+    sharded over the mesh, a ``shard_map`` runs the fused tokenize kernel
+    per shard, and the per-shard unique rows flow straight into the
+    ``all_to_all`` exchange via the sharded engine's ``feed_device`` — the
+    map->shuffle hand-off never touches the host.  The host's only
+    steady-state work is streaming file bytes and the one packed dictionary
+    fetch per group (pipelined one group behind, so it overlaps compute).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dataclasses import replace
+
+    from map_oxidize_tpu.ops.device_tokenize import (
+        _power_tables,
+        tokenize_count_core,
+    )
+    from map_oxidize_tpu.parallel.engine import ShardedReduceEngine
+    from map_oxidize_tpu.parallel.mesh import SHARD_AXIS
+
+    config.validate()
+    if config.checkpoint_dir:
+        _log.warning("checkpointing is not wired for the device map path; "
+                     "running without (use mapper='native' to checkpoint)")
+    metrics = Metrics()
+    N = config.chunk_bytes
+    max_tokens = N // 2 + 1
+    out_keys = min(config.device_chunk_keys, max_tokens)  # kernel clamps
+    fetch = min(1 << 16, out_keys)
+    # build the mesh first so S is known: the engine's merge batch is one
+    # tokenized group (S shards x out_keys rows), so its bucket_cap and
+    # feed_batch must be sized for that, not for config.batch_size
+    from map_oxidize_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(config.num_shards, config.backend)
+    S = mesh.shape[SHARD_AXIS]
+    engine = ShardedReduceEngine(
+        replace(config, batch_size=S * out_keys), SumReducer(), mesh=mesh)
+    pk = _power_tables(N)
+    rep_spec = NamedSharding(mesh, P())
+    row_spec = NamedSharding(mesh, P(SHARD_AXIS))
+    tables = tuple(jax.device_put(t, rep_spec) for t in pk)
+
+    group_fn = jax.jit(jax.shard_map(
+        lambda chunk, a, b, c, d: tokenize_count_core(
+            chunk, a, b, c, d, max_tokens=max_tokens, out_keys=out_keys,
+            fetch_keys=fetch, ngram=ngram),
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(), P(), P(), P()),
+        out_specs=P(SHARD_AXIS),
+    ))
+
+    dicts = [_DictBuilder(out_keys, fetch, ngram) for _ in range(S)]
+    pending: tuple | None = None
+    n_chunks = 0
+
+    def _process_group(chunks: list[bytes], outs) -> None:
+        u_hi, u_lo, reps, packed_dev = outs
+        packed = np.asarray(packed_dev).reshape(S, -1)  # ONE fetch per group
+        for s, chunk in enumerate(chunks):
+            dicts[s].process_packed(
+                chunk, packed[s],
+                lambda nu, s=s: dicts[s]._fetch_overflow(
+                    u_hi[s * out_keys:(s + 1) * out_keys],
+                    u_lo[s * out_keys:(s + 1) * out_keys],
+                    reps[s * out_keys:(s + 1) * out_keys], nu))
+
+    with metrics.phase("map+reduce"):
+        group: list[bytes] = []
+        for chunk in iter_chunks_capped(config.input_path, config.chunk_bytes):
+            group.append(bytes(chunk))
+            n_chunks += 1
+            if len(group) < S:
+                continue
+            pending = _dispatch_group(group, group_fn, N, tables, engine,
+                                      row_spec, pending, _process_group)
+            group = []
+            engine.hint_live_upper_bound(
+                sum(len(d.dictionary) for d in dicts) + 2 * S * out_keys)
+        if group:  # short tail group: pad with empty (all-space) chunks
+            group += [b""] * (S - len(group))
+            pending = _dispatch_group(group, group_fn, N, tables, engine,
+                                      row_spec, pending, _process_group)
+        if pending is not None:
+            _process_group(*pending)
+
+    with metrics.phase("finalize"):
+        dictionary = dicts[0].dictionary
+        for d in dicts[1:]:
+            dictionary.update(d.dictionary)
+        counts = _readback(engine, dictionary)
+        top = _top_k(counts, config.top_k)
+
+    records_in = sum(d.records_in for d in dicts)
+    total = sum(counts.values())
+    if records_in and total != records_in:
+        raise RuntimeError(
+            f"count conservation violated: device tokenized "
+            f"{records_in} records but counts sum to {total}"
+        )
+
+    with metrics.phase("write"):
+        if config.output_path:
+            write_final_result(config.output_path, counts.items())
+
+    metrics.set("records_in", records_in)
+    metrics.set("distinct_keys", len(counts))
+    metrics.set("chunks", n_chunks)
+    metrics.set("shards", S)
+    result = JobResult(counts=counts, top=top, metrics=metrics.summary())
+    if config.metrics:
+        _log.info("metrics: %s", result.metrics)
+    return result
+
+
+def _dispatch_group(group, group_fn, chunk_bytes, tables, engine, row_spec,
+                    pending, process):
+    """Upload one S-chunk group, run the sharded tokenize, feed the engine
+    (all async), then block on the PREVIOUS group's dictionary fetch so it
+    overlaps this group's compute."""
+    stacked = np.concatenate([pad_chunk(c, chunk_bytes) for c in group])
+    dev = jax.device_put(stacked, row_spec)
+    u_hi, u_lo, cnts, reps, packed = group_fn(dev, *tables)
+    engine.feed_device(u_hi, u_lo, cnts)
+    if pending is not None:
+        process(*pending)
+    return (group, (u_hi, u_lo, reps, packed))
+
+
+def run_device_wordcount_job(config: JobConfig, ngram: int = 1) -> JobResult:
+    """Word/n-gram count with the map phase on device (single chip)."""
     config.validate()
     if config.checkpoint_dir:
         _log.warning("checkpointing is not wired for the device map path; "
@@ -105,8 +257,8 @@ def run_device_wordcount_job(config: JobConfig) -> JobResult:
     metrics = Metrics()
     engine = DeviceReduceEngine(config, SumReducer())
     tok = DeviceTokenizer(config.chunk_bytes, config.device_chunk_keys,
-                          device=engine.device)
-    dicts = _DictBuilder(config.device_chunk_keys, tok.fetch_keys)
+                          device=engine.device, ngram=ngram)
+    dicts = _DictBuilder(tok.out_keys, tok.fetch_keys, ngram)
 
     pending: tuple | None = None
     n_chunks = 0
@@ -128,8 +280,7 @@ def run_device_wordcount_job(config: JobConfig) -> JobResult:
 
     with metrics.phase("finalize"):
         counts = _readback(engine, dicts.dictionary)
-        top = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))[
-            : config.top_k]
+        top = _top_k(counts, config.top_k)
 
     total = sum(counts.values())
     if dicts.records_in and total != dicts.records_in:

@@ -79,14 +79,24 @@ def _readback(engine: StreamingEngineBase, dictionary: HashDictionary):
     vals = np.asarray(vals)
     live = ~((hi == np.uint32(SENTINEL)) & (lo == np.uint32(SENTINEL)))
     k64 = join_u64(hi[live], lo[live])
-    out: dict[bytes, int] = {}
-    for h, v in zip(k64.tolist(), vals[live].tolist()):
-        out[dictionary.lookup(h)] = v
+    # high-cardinality workloads make this loop the finalize hot spot — bind
+    # the raw dict lookup once (no method dispatch per key)
+    lookup = dictionary._d.__getitem__
+    out = {lookup(h): v for h, v in zip(k64.tolist(), vals[live].tolist())}
     if len(out) != n:
         raise RuntimeError(
             f"readback found {len(out)} live keys but engine reported {n}"
         )
     return out
+
+
+def _top_k(counts: dict[bytes, int], k: int) -> list[tuple[bytes, int]]:
+    """Reference top-k (count desc, word asc tie-break) in O(n log k) — a
+    full sort of a wide key space (bigram: ~|V|^2 keys) costs more than the
+    whole device reduce."""
+    import heapq
+
+    return heapq.nsmallest(k, counts.items(), key=lambda kv: (-kv[1], kv[0]))
 
 
 def _track_offsets(chunk_iter, start_off: int, offsets: dict, base_idx: int):
@@ -193,8 +203,7 @@ def run_wordcount_job(config: JobConfig, mapper: Mapper, reducer: Reducer,
     # --- finalize on device; read back to host strings
     with metrics.phase("finalize"):
         counts = _readback(engine, dictionary)
-        k = config.top_k
-        top = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))[:k]
+        top = _top_k(counts, config.top_k)
 
     # conservation check: every token mapped lands in exactly one count
     # (Σ counts == Σ records_in); the reference has no such invariant check.

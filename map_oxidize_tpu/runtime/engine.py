@@ -203,6 +203,11 @@ class StreamingEngineBase(abc.ABC):
         otherwise need."""
         self._total_hint = n
 
+    def hint_live_upper_bound(self, ub: int) -> None:
+        """Tighten the host-side live-key bound from external exact knowledge
+        (e.g. the dictionary's distinct-key count), avoiding growth syncs."""
+        self._n_live_ub = min(self._n_live_ub, ub)
+
     def _ensure_capacity(self, incoming: int) -> None:
         if self.capacity >= self.max_capacity:
             return
@@ -354,11 +359,6 @@ class DeviceReduceEngine(StreamingEngineBase):
             *self._acc, self._ovf, hi, lo, vals, combine=self.combine
         )
         self._n_live_ub += incoming
-
-    def hint_live_upper_bound(self, ub: int) -> None:
-        """Tighten the host-side live-key bound from external exact knowledge
-        (e.g. the dictionary's distinct-key count), avoiding growth syncs."""
-        self._n_live_ub = min(self._n_live_ub, ub)
 
     def _check_health(self) -> None:
         dropped = int(self._ovf)  # host sync point

@@ -57,9 +57,66 @@ def test_run_job_dispatch_and_fallbacks(tmp_path, rng):
     cfg_uni = JobConfig(input_path=str(corpus), output_path="", backend="cpu",
                         mapper="device", tokenizer="unicode")
     assert resolve_mapper(cfg_uni, "wordcount") == "native"
-    assert resolve_mapper(cfg_dev, "bigram") == "native"
+    assert resolve_mapper(cfg_dev, "bigram") == "device"
+    assert resolve_mapper(cfg_dev, "invertedindex") == "native"
     got_dev = run_job(cfg_dev, "wordcount").counts
     got_py = run_job(
         JobConfig(input_path=str(corpus), output_path="", backend="cpu",
                   mapper="python"), "wordcount").counts
     assert got_dev == got_py == dict(wordcount_model([raw]))
+
+
+def _bigram_model_for_chunks(path, chunk_bytes):
+    """Per-chunk bigram counts with the device path's own chunking (bigram
+    results are chunking-dependent by documented contract)."""
+    from map_oxidize_tpu.io.splitter import iter_chunks_capped
+    from map_oxidize_tpu.workloads.wordcount import tokenize
+
+    want = Counter()
+    for chunk in iter_chunks_capped(str(path), chunk_bytes):
+        toks = tokenize(bytes(chunk))
+        want.update(toks[i] + b" " + toks[i + 1] for i in range(len(toks) - 1))
+    return dict(want)
+
+
+def test_device_bigram_matches_host_model(tmp_path, rng):
+    corpus, _ = _write_corpus(tmp_path, rng, lines=400)
+    cfg = JobConfig(input_path=str(corpus), output_path="", backend="cpu",
+                    mapper="device", chunk_bytes=4096,
+                    device_chunk_keys=4096, initial_key_capacity=256)
+    res = run_job(cfg, "bigram")
+    assert res.counts == _bigram_model_for_chunks(corpus, 4096)
+
+
+def test_device_out_keys_clamped_to_max_tokens(tmp_path, rng):
+    """Regression: out_keys > max_tokens used to desync the host's packed
+    slicing from the kernel's clamped output width (empty rep array)."""
+    corpus, raw = _write_corpus(tmp_path, rng, lines=200)
+    cfg = JobConfig(input_path=str(corpus), output_path="", backend="cpu",
+                    mapper="device", chunk_bytes=2048,
+                    device_chunk_keys=1 << 16)  # >> max_tokens = 1025
+    assert run_job(cfg, "wordcount").counts == dict(wordcount_model([raw]))
+
+
+def test_sharded_device_wordcount(tmp_path, rng):
+    """Device map composed with the all_to_all sharded engine on the 8-device
+    virtual mesh: tokenize under shard_map feeds the exchange directly."""
+    corpus, raw = _write_corpus(tmp_path, rng, lines=600)
+    cfg = JobConfig(input_path=str(corpus), output_path=str(tmp_path / "o.txt"),
+                    backend="cpu", mapper="device", num_shards=8,
+                    chunk_bytes=2048, device_chunk_keys=512,
+                    key_capacity=1 << 16)
+    res = run_job(cfg, "wordcount")
+    want = wordcount_model([raw])
+    assert res.counts == dict(want)
+    assert res.top == top_k_model(want, 10)
+    assert res.metrics["shards"] == 8
+
+
+def test_sharded_device_bigram(tmp_path, rng):
+    corpus, _ = _write_corpus(tmp_path, rng, lines=400)
+    cfg = JobConfig(input_path=str(corpus), output_path="", backend="cpu",
+                    mapper="device", num_shards=8, chunk_bytes=2048,
+                    device_chunk_keys=1024, key_capacity=1 << 17)
+    res = run_job(cfg, "bigram")
+    assert res.counts == _bigram_model_for_chunks(corpus, 2048)
