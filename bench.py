@@ -243,6 +243,17 @@ def _bench_workloads(run_job, JobConfig) -> dict:
         "point_iters_per_sec": round(r.metrics["records_in"] / secs, 1),
         "iters": int(r.metrics["iters"]),
     }
+    # HBM-resident variant: points transfer once, iterations are MXU matmuls
+    cfg_dev = JobConfig(input_path=pts_path, output_path="", backend="auto",
+                        metrics=True, kmeans_k=64, kmeans_iters=20,
+                        mapper="device")
+    run_job(cfg_dev, "kmeans")  # warm
+    r, secs = best_of(lambda: run_job(cfg_dev, "kmeans"))
+    out["kmeans_device_400k_d32_k64_20iter"] = {
+        "best_s": round(secs, 3),
+        "point_iters_per_sec": round(r.metrics["records_in"] / secs, 1),
+        "iters": int(r.metrics["iters"]),
+    }
     return out
 
 

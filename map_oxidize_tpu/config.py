@@ -39,8 +39,9 @@ class JobConfig:
     backend: str = "auto"
     #: number of mesh shards for the device engine; 0 = all local devices
     num_shards: int = 0
-    #: tokenizer mode: 'ascii' (C++-accelerated byte path) or 'unicode'
-    #: (exact Rust split_whitespace/to_lowercase semantics, main.rs:96-97)
+    #: tokenizer mode: 'ascii' (byte path) or 'unicode' (exact Rust
+    #: split_whitespace/to_lowercase semantics, main.rs:96-97); both are
+    #: C++-accelerated, the device mapper is ascii-only
     tokenizer: str = "ascii"
     #: map-phase placement: 'device' tokenizes+hashes on the TPU itself,
     #: 'native' uses the C++ host loop, 'python' the pure fallback; 'auto'

@@ -27,7 +27,7 @@ def load_or_none():
     return _cached
 
 
-def stream_or_none(ngram: int = 1):
+def stream_or_none(ngram: int = 1, tokenizer: str = "ascii"):
     """A per-thread :class:`~map_oxidize_tpu.native.build.StreamPool` (the
     driver-facing flavour: cross-chunk C++ dictionary, delta drains, one
     stream per map worker thread), or None when the native build is
@@ -36,4 +36,4 @@ def stream_or_none(ngram: int = 1):
         return None
     from map_oxidize_tpu.native.build import StreamPool
 
-    return StreamPool(ngram)
+    return StreamPool(ngram, tokenizer)

@@ -76,6 +76,11 @@ def _load_lib():
         lib.moxt_map.restype = ctypes.c_int32
         lib.moxt_map.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                                  ctypes.c_int64]
+        lib.moxt_set_unicode.restype = ctypes.c_int32
+        lib.moxt_set_unicode.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64]
         lib.moxt_chunk_unique.restype = ctypes.c_int64
         lib.moxt_chunk_unique.argtypes = [ctypes.c_void_p]
         lib.moxt_chunk_tokens.restype = ctypes.c_int64
@@ -113,6 +118,71 @@ def _load_lib():
         return _lib
 
 
+def _raise_map_error(rc: int) -> None:
+    """Map a native return code to the same exception type the Python
+    fallback raises for that condition (tests assert error-type parity)."""
+    if rc == 0:
+        return
+    if rc == 1:
+        raise ValueError("64-bit hash collision in native map")
+    if rc == 3:
+        raise UnicodeDecodeError(
+            "utf-8", b"", 0, 1,
+            "invalid UTF-8 in unicode-mode native map (same input fails "
+            "the Python fallback's chunk.decode)")
+    raise RuntimeError(f"native map error {rc}")
+
+
+_UNICODE_TABLES = None
+
+
+def _unicode_tables():
+    """(ws_cps, map_cps, map_offs, map_blob, cased_cps, ignorable_cps) numpy
+    arrays generated from Python's own Unicode behavior — str.isspace() and
+    str.lower() ARE the semantics the unicode tokenizer mode promises
+    (wordcount.tokenize), so deriving the C++ tables from them makes parity
+    hold by construction.
+
+    The cased / case-ignorable sets (CPython's Final_Sigma context rule for
+    U+03A3) are probed through ``lower()`` itself rather than re-deriving
+    Unicode properties: with P1 = "AcΣ".lower() ending in final sigma and
+    P2 = "ΑΣc".lower() keeping medial sigma, CPython's own backward/forward
+    scans give P1∧P2 ⇔ c case-ignorable and P1∧¬P2 ⇔ c cased."""
+    global _UNICODE_TABLES
+    if _UNICODE_TABLES is None:
+        ws = np.array([cp for cp in range(0x3001) if chr(cp).isspace()],
+                      np.uint32)
+        cps, offs, parts = [], [0], []
+        cased, ignorable = [], []
+        total = 0
+        for cp in range(0x110000):
+            if 0xD800 <= cp < 0xE000:
+                continue  # surrogates: unencodable, never appear decoded
+            c = chr(cp)
+            low = c.lower()
+            if low != c:
+                b = low.encode("utf-8")
+                cps.append(cp)
+                total += len(b)
+                offs.append(total)
+                parts.append(b)
+            p1 = ("A" + c + "Σ").lower()[-1] == "ς"
+            p2 = ("ΑΣ" + c).lower()[1] == "ς"
+            if p1 and not p2:
+                cased.append(cp)
+            elif p1 and p2:
+                ignorable.append(cp)
+        _UNICODE_TABLES = (
+            ws,
+            np.array(cps, np.uint32),
+            np.array(offs, np.int64),
+            np.frombuffer(b"".join(parts), np.uint8).copy(),
+            np.array(cased, np.uint32),
+            np.array(ignorable, np.uint32),
+        )
+    return _UNICODE_TABLES
+
+
 class NativeStream:
     """Persistent native mapper state: per-chunk (hash, count) columns plus a
     cross-chunk C++ dictionary drained as deltas.
@@ -121,7 +191,7 @@ class NativeStream:
     C++ loop is single-core-bound anyway; concurrent callers would only
     interleave on one core)."""
 
-    def __init__(self, ngram: int = 1):
+    def __init__(self, ngram: int = 1, tokenizer: str = "ascii"):
         if not 1 <= ngram <= 16:
             raise ValueError("ngram must be in [1, 16]")
         self._lib = _load_lib()
@@ -129,6 +199,17 @@ class NativeStream:
         if not self._st:
             raise RuntimeError("moxt_new failed")
         self.ngram = ngram
+        self.tokenizer = tokenizer
+        if tokenizer == "unicode":
+            ws, cps, offs, blob, cased, ign = _unicode_tables()
+            rc = self._lib.moxt_set_unicode(
+                self._st, ws.ctypes.data, ws.size, cps.ctypes.data,
+                offs.ctypes.data, blob.ctypes.data, cps.size,
+                cased.ctypes.data, cased.size, ign.ctypes.data, ign.size)
+            if rc:
+                raise RuntimeError(f"moxt_set_unicode failed ({rc})")
+        elif tokenizer != "ascii":
+            raise ValueError(f"unknown tokenizer {tokenizer!r}")
         self._lock = threading.Lock()
 
     def close(self) -> None:
@@ -151,10 +232,7 @@ class NativeStream:
             return self._collect_locked(rc, drain_dict)
 
     def _collect_locked(self, rc: int, drain_dict: bool) -> MapOutput:
-        if rc == 1:
-            raise ValueError("64-bit hash collision in native map")
-        if rc:
-            raise RuntimeError(f"native map error {rc}")
+        _raise_map_error(rc)
         nu = int(self._lib.moxt_chunk_unique(self._st))
         n_tokens = int(self._lib.moxt_chunk_tokens(self._st))
         hashes = np.empty(nu, np.uint64)
@@ -186,11 +264,10 @@ class NativeStream:
                 with self._lock:
                     consumed = int(self._lib.moxt_map_range(
                         self._st, f, off, chunk_bytes))
-                    if consumed == -1:
-                        raise ValueError("64-bit hash collision in native map")
-                    if consumed <= 0:
-                        raise RuntimeError(
-                            f"native map_range error {consumed} at {off}")
+                    if consumed < 0:
+                        _raise_map_error(-consumed)
+                    if consumed == 0:
+                        raise RuntimeError(f"native map_range stalled at {off}")
                     out = self._collect_locked(0, drain_dict=True)
                 off += consumed
                 yield out, off
@@ -242,11 +319,11 @@ class NativeStream:
                 with self._lock:
                     consumed = int(self._lib.moxt_map_range_docs(
                         self._st, f, off, chunk_bytes))
-                    if consumed == -1:
-                        raise ValueError("64-bit hash collision in native map")
-                    if consumed <= 0:
+                    if consumed < 0:
+                        _raise_map_error(-consumed)
+                    if consumed == 0:
                         raise RuntimeError(
-                            f"native map_range_docs error {consumed} at {off}")
+                            f"native map_range_docs stalled at {off}")
                     out = self._collect_pairs_locked()
                 off += consumed
                 yield out
@@ -290,8 +367,9 @@ class StreamPool:
     but ``HashDictionary.update`` is idempotent (and collision-checking), so
     the driver-side union is still exact."""
 
-    def __init__(self, ngram: int = 1):
+    def __init__(self, ngram: int = 1, tokenizer: str = "ascii"):
         self.ngram = ngram
+        self.tokenizer = tokenizer
         self._tls = threading.local()
         self._streams: list[NativeStream] = []
         self._lock = threading.Lock()
@@ -299,7 +377,7 @@ class StreamPool:
     def get(self) -> NativeStream:
         s = getattr(self._tls, "stream", None)
         if s is None:
-            s = NativeStream(self.ngram)
+            s = NativeStream(self.ngram, self.tokenizer)
             self._tls.stream = s
             with self._lock:
                 self._streams.append(s)

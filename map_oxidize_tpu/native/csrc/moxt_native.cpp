@@ -280,12 +280,69 @@ enum { UP_OK = 0, UP_COLLISION = 1 };
 // Mapper state (exposed as an opaque handle)
 // ---------------------------------------------------------------------------
 
+// Unicode tokenizer tables (set once via moxt_set_unicode; generated on the
+// Python side from str.lower()/str.isspace() so parity with the Python
+// fallback holds by construction, not by re-implementing Unicode here).
+struct UnicodeTables {
+  // whitespace: bitmap over codepoints 0..0x3000 inclusive (str.isspace()'s
+  // entire set fits — max member is U+3000 IDEOGRAPHIC SPACE)
+  uint64_t ws_bits[(0x3001 + 63) / 64] = {0};
+  // lowercase: open-addressed cp -> (offset, len) into utf8 blob
+  uint32_t* map_cp = nullptr;   // keys (+1 so 0 means empty slot)
+  uint32_t* map_off = nullptr;
+  uint8_t* map_len = nullptr;
+  int64_t map_cap = 0;          // power of two
+  uint8_t* blob = nullptr;
+  int64_t blob_n = 0;
+
+  // Final_Sigma context sets (str.lower() is context-sensitive for U+03A3
+  // only): full-range bitmaps, 0x110000 bits = 136 KiB each
+  uint64_t* cased_bits = nullptr;
+  uint64_t* ign_bits = nullptr;
+
+  bool is_ws(uint32_t cp) const {
+    return cp <= 0x3000 && (ws_bits[cp >> 6] >> (cp & 63)) & 1;
+  }
+  bool is_cased(uint32_t cp) const {
+    return cp <= 0x10FFFF && (cased_bits[cp >> 6] >> (cp & 63)) & 1;
+  }
+  bool is_ignorable(uint32_t cp) const {
+    return cp <= 0x10FFFF && (ign_bits[cp >> 6] >> (cp & 63)) & 1;
+  }
+  // returns len of the lowercase expansion written to *out, or 0 = identity
+  int lower(uint32_t cp, const uint8_t** out) const {
+    if (!map_cap) return 0;
+    int64_t j = (cp * 0x9E3779B1u) & (map_cap - 1);
+    while (map_cp[j]) {
+      if (map_cp[j] == cp + 1) {
+        *out = blob + map_off[j];
+        return map_len[j];
+      }
+      j = (j + 1) & (map_cap - 1);
+    }
+    return 0;
+  }
+  void destroy() {
+    free(map_cp);
+    free(map_off);
+    free(map_len);
+    free(blob);
+    free(cased_bits);
+    free(ign_bits);
+  }
+};
+
 struct MoxtState {
   int32_t ngram = 1;
   Table chunk;        // per-chunk (hash -> count); epoch-cleared
   Arena chunk_arena;  // key bytes for the current chunk (reset per chunk)
   Table dict;         // persistent hash -> bytes across chunks
   Arena dict_arena;   // persistent key bytes (append-only, insert order)
+  // unicode mode: transform buffer + tables (null tables = ascii mode)
+  bool unicode = false;
+  UnicodeTables utab;
+  uint8_t* utrans = nullptr;
+  int64_t utrans_cap = 0;
   // dictionary append log (insert order == dict_arena order)
   uint64_t* log_h = nullptr;
   uint32_t* log_len = nullptr;
@@ -410,9 +467,185 @@ inline int chunk_upsert(MoxtState* st, const uint8_t* p, uint32_t len,
   }
 }
 
+// Decode one UTF-8 codepoint at src[i..n): writes (cp, len); returns false
+// on invalid input (stray continuation, truncation, overlong, surrogate,
+// out of range) — the strict checks CPython's utf-8 decoder applies.
+inline bool decode_cp(const uint8_t* src, int64_t n, int64_t i, uint32_t* cp,
+                      int* len) {
+  uint8_t c = src[i];
+  if (c < 0x80) {
+    *cp = c;
+    *len = 1;
+    return true;
+  }
+  uint32_t v;
+  int l;
+  if ((c & 0xE0) == 0xC0) {
+    l = 2;
+    v = c & 0x1F;
+  } else if ((c & 0xF0) == 0xE0) {
+    l = 3;
+    v = c & 0x0F;
+  } else if ((c & 0xF8) == 0xF0) {
+    l = 4;
+    v = c & 0x07;
+  } else {
+    return false;
+  }
+  if (i + l > n) return false;
+  for (int k = 1; k < l; k++) {
+    uint8_t cc = src[i + k];
+    if ((cc & 0xC0) != 0x80) return false;
+    v = (v << 6) | (cc & 0x3F);
+  }
+  if ((l == 2 && v < 0x80) || (l == 3 && v < 0x800) ||
+      (l == 4 && v < 0x10000) || v > 0x10FFFF ||
+      (v >= 0xD800 && v <= 0xDFFF))
+    return false;
+  *cp = v;
+  *len = l;
+  return true;
+}
+
+// UTF-8 transform for unicode mode: decode, map every Unicode-whitespace
+// codepoint to one ASCII space and every cased codepoint to its lowercase
+// expansion, copy everything else verbatim.  The output feeds the unchanged
+// ASCII pipeline (its space-split + A-Z lowercase are no-ops on this
+// normalized stream), which is exactly Python's
+// ``chunk.decode('utf-8').lower().split()`` followed by utf-8 re-encoding.
+// U+03A3 GREEK CAPITAL SIGMA follows CPython's Final_Sigma rule: lowercase
+// to final form U+03C2 when the nearest non-case-ignorable neighbor before
+// it is cased and the nearest after it is not (or absent); the cased /
+// case-ignorable sets come from the Python-derived tables.
+// Returns the output length, or -1 on invalid UTF-8 (the Python fallback
+// raises UnicodeDecodeError on the same input).
+int64_t transform_unicode(MoxtState* st, const uint8_t* src, int64_t n) {
+  // worst-case growth is 1.5x (e.g. U+0130 -> "i" U+0307); 2x is safe slack
+  int64_t need = 2 * n + 16;
+  if (need > st->utrans_cap) {
+    free(st->utrans);
+    st->utrans = static_cast<uint8_t*>(malloc(need));
+    st->utrans_cap = need;
+  }
+  const UnicodeTables& u = st->utab;
+  uint8_t* out = st->utrans;
+  int64_t w = 0;
+  int64_t i = 0;
+  // Final_Sigma backward state: whether the nearest preceding
+  // non-case-ignorable codepoint was cased (O(1) as we stream forward)
+  bool prev_cased = false;
+  while (i < n) {
+    uint8_t c = src[i];
+    if (c < 0x80) {
+      // ASCII fast path (also covers the \x1c..\x1f separators that
+      // bytes.split() ignores but str.split() treats as whitespace)
+      if (c == ' ' || (c >= 0x09 && c <= 0x0D) || (c >= 0x1C && c <= 0x1F)) {
+        out[w++] = ' ';
+        prev_cased = false;
+      } else {
+        bool up = (c >= 'A' && c <= 'Z');
+        out[w++] = up ? c + 32 : c;
+        if (!u.is_ignorable(c)) prev_cased = u.is_cased(c);
+      }
+      i++;
+      continue;
+    }
+    uint32_t cp;
+    int len;
+    if (!decode_cp(src, n, i, &cp, &len)) return -1;
+    if (u.is_ws(cp)) {
+      out[w++] = ' ';
+      prev_cased = false;
+    } else if (cp == 0x3A3) {  // capital sigma: context-sensitive
+      bool final_sigma = prev_cased;
+      if (final_sigma) {
+        // forward scan: first non-case-ignorable codepoint must not be cased
+        int64_t j = i + len;
+        while (j < n) {
+          uint32_t cj;
+          int lj;
+          if (!decode_cp(src, n, j, &cj, &lj)) return -1;
+          if (!u.is_ignorable(cj)) {
+            final_sigma = !u.is_cased(cj);
+            break;
+          }
+          j += lj;
+        }
+      }
+      // U+03C2 / U+03C3, both 2-byte
+      out[w++] = 0xCF;
+      out[w++] = final_sigma ? 0x82 : 0x83;
+      prev_cased = true;  // sigma is cased, not ignorable
+    } else {
+      const uint8_t* rep;
+      int rl = u.lower(cp, &rep);
+      if (rl) {
+        memcpy(out + w, rep, rl);
+        w += rl;
+      } else {
+        memcpy(out + w, src + i, len);
+        w += len;
+      }
+      if (!u.is_ignorable(cp)) prev_cased = u.is_cased(cp);
+    }
+    i += len;
+  }
+  return w;
+}
+
 }  // namespace
 
 extern "C" {
+
+// Install the unicode tables (whitespace codepoints; lowercase map as
+// parallel arrays cp / blob-offset, with offs[n_map] = total blob bytes;
+// cased / case-ignorable codepoint lists for the Final_Sigma rule).
+// Must be called before the first unicode-mode moxt_map.
+int32_t moxt_set_unicode(MoxtState* st, const uint32_t* ws_cps, int64_t n_ws,
+                         const uint32_t* map_cps, const int64_t* map_offs,
+                         const uint8_t* map_bytes, int64_t n_map,
+                         const uint32_t* cased_cps, int64_t n_cased,
+                         const uint32_t* ign_cps, int64_t n_ign) {
+  if (!st) return 2;
+  UnicodeTables& u = st->utab;
+  for (int64_t i = 0; i < n_ws; i++) {
+    uint32_t cp = ws_cps[i];
+    if (cp > 0x3000) return 2;  // table contract: isspace() max is U+3000
+    u.ws_bits[cp >> 6] |= 1ULL << (cp & 63);
+  }
+  constexpr int64_t kBitWords = (0x110000 + 63) / 64;
+  u.cased_bits = static_cast<uint64_t*>(calloc(kBitWords, 8));
+  u.ign_bits = static_cast<uint64_t*>(calloc(kBitWords, 8));
+  for (int64_t i = 0; i < n_cased; i++) {
+    uint32_t cp = cased_cps[i];
+    if (cp > 0x10FFFF) return 2;
+    u.cased_bits[cp >> 6] |= 1ULL << (cp & 63);
+  }
+  for (int64_t i = 0; i < n_ign; i++) {
+    uint32_t cp = ign_cps[i];
+    if (cp > 0x10FFFF) return 2;
+    u.ign_bits[cp >> 6] |= 1ULL << (cp & 63);
+  }
+  int64_t cap = 1;
+  while (cap < 4 * n_map) cap <<= 1;
+  u.map_cap = cap;
+  u.map_cp = static_cast<uint32_t*>(calloc(cap, 4));
+  u.map_off = static_cast<uint32_t*>(malloc(cap * 4));
+  u.map_len = static_cast<uint8_t*>(malloc(cap));
+  u.blob_n = map_offs[n_map];
+  u.blob = static_cast<uint8_t*>(malloc(u.blob_n ? u.blob_n : 1));
+  memcpy(u.blob, map_bytes, u.blob_n);
+  for (int64_t i = 0; i < n_map; i++) {
+    uint32_t cp = map_cps[i];
+    int64_t j = (cp * 0x9E3779B1u) & (cap - 1);
+    while (u.map_cp[j]) j = (j + 1) & (cap - 1);
+    u.map_cp[j] = cp + 1;
+    u.map_off[j] = (uint32_t)map_offs[i];
+    u.map_len[j] = (uint8_t)(map_offs[i + 1] - map_offs[i]);
+  }
+  st->unicode = true;
+  return 0;
+}
 
 MoxtState* moxt_new(int32_t ngram) {
   if (ngram < 1) return nullptr;
@@ -429,6 +662,8 @@ void moxt_free(MoxtState* st) {
   st->dict.destroy();
   st->chunk_arena.destroy();
   st->dict_arena.destroy();
+  st->utab.destroy();
+  free(st->utrans);
   free(st->log_h);
   free(st->log_len);
   free(st->low);
@@ -440,7 +675,8 @@ void moxt_free(MoxtState* st) {
 }
 
 // Map one chunk.  Returns 0 ok, 1 = 64-bit hash collision (job must abort;
-// the Python paths raise on the same condition), 2 = bad state.
+// the Python paths raise on the same condition), 2 = bad state, 3 = invalid
+// UTF-8 in unicode mode (the Python fallback raises UnicodeDecodeError).
 int32_t moxt_map(MoxtState* st, const uint8_t* data, int64_t len) {
   if (!st || st->error == 2) return 2;
   st->error = 0;
@@ -448,6 +684,16 @@ int32_t moxt_map(MoxtState* st, const uint8_t* data, int64_t len) {
   st->chunk.new_epoch();
   st->chunk_arena.reset();
   if (len <= 0) return 0;
+  if (st->unicode) {
+    int64_t tn = transform_unicode(st, data, len);
+    if (tn < 0) {
+      st->error = 3;
+      return 3;
+    }
+    data = st->utrans;
+    len = tn;
+    if (len <= 0) return 0;
+  }
 
   if (len > st->scratch_cap) {
     free(st->low);
@@ -559,7 +805,9 @@ int64_t moxt_chunk_tokens(MoxtState* st) { return st->n_tokens; }
 int32_t moxt_map_docs(MoxtState* st, const uint8_t* data, int64_t len,
                       int64_t base_doc) {
   if (!st || st->error == 2) return 2;
-  if (st->ngram != 1) { st->error = 2; return 2; }
+  // unicode transform would shift byte offsets and break doc identity; the
+  // driver keeps unicode inverted-index on the Python path
+  if (st->ngram != 1 || st->unicode) { st->error = 2; return 2; }
   st->error = 0;
   st->n_tokens = 0;
   st->pair_n = 0;
@@ -734,7 +982,31 @@ int64_t moxt_map_range(MoxtState* st, MoxtFile* f, int64_t off, int64_t want) {
         if (is_ascii_space(p[i])) { cut = i; break; }
       }
     }
-    if (cut >= 0) len = cut + 1;  // else: one giant token, hard cut at want
+    if (cut >= 0) {
+      len = cut + 1;
+    } else if (st->unicode) {
+      // hard cut on a whitespace-free window: in unicode mode an arbitrary
+      // byte cut can split a multi-byte sequence and abort valid input as
+      // invalid UTF-8 — back off (<= 3 bytes) to the last complete codepoint
+      // (ascii mode's hard cut merely splits one token, which is fine)
+      int64_t c = len;
+      int back = 0;
+      while (c > 0 && back < 4 && (p[c - 1] & 0xC0) == 0x80) {
+        c--;
+        back++;
+      }
+      if (c > 0) {
+        uint8_t lead = p[c - 1];
+        int need = lead < 0x80 ? 1
+                   : (lead & 0xE0) == 0xC0 ? 2
+                   : (lead & 0xF0) == 0xE0 ? 3
+                   : (lead & 0xF8) == 0xF0 ? 4
+                                           : 1;
+        if (c - 1 + need > len && c - 1 > 0) len = c - 1;
+        // c-1 == 0 with an incomplete lead: the window IS one truncated
+        // sequence — leave len alone and let the decoder report it
+      }
+    }
   }
   int32_t rc = moxt_map(st, f->data + off, len);
   if (rc != 0) return -(int64_t)rc;

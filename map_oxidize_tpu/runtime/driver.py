@@ -177,7 +177,8 @@ def run_wordcount_job(config: JobConfig, mapper: Mapper, reducer: Reducer,
                 _log.debug(
                     "native mmap map path: chunks map inline in C++; "
                     "num_map_workers/max_retries do not apply (a map error "
-                    "here is a hash collision, which no retry can fix)")
+                    "here is a hash collision or invalid UTF-8, which no "
+                    "retry can fix)")
             else:
                 chunks = _track_offsets(
                     iter_chunks(config.input_path, chunk_bytes, resume_off),
@@ -327,8 +328,15 @@ class KMeansResult:
 
 def run_kmeans_job(config: JobConfig, centroids: np.ndarray | None = None
                    ) -> KMeansResult:
-    """Streamed k-means (BASELINE config #5): ``kmeans_iters`` iterations of
-    map (host assign + per-chunk partial sums) -> device vector-sum reduce.
+    """k-means (BASELINE config #5), two execution paths:
+
+    * streamed (default): ``kmeans_iters`` iterations of map (host assign +
+      per-chunk partial sums) -> device vector-sum reduce; points never sit
+      in host or device memory whole.
+    * ``mapper='device'``: HBM-resident — points transfer once and every
+      iteration is MXU work (distance matmul, one-hot matmul), sharded over
+      the mesh with one psum per iteration when more than one device is
+      visible.  Wins when iterations amortize the one-time transfer.
 
     Input: a ``.npy`` float32 ``(n, d)`` points file, memory-mapped and
     streamed by row ranges.  Initial centroids default to the first
@@ -355,13 +363,34 @@ def run_kmeans_job(config: JobConfig, centroids: np.ndarray | None = None
         centroids = np.asarray(pts[:config.kmeans_k], np.float32)
     centroids = np.asarray(centroids, np.float32)
     rows = max(1, config.chunk_bytes // (4 * d))
+    device_mode = config.mapper == "device"
     with metrics.phase("iterate"):
-        for _ in range(config.kmeans_iters):
-            engine = make_engine(config, SumReducer(),
-                                 value_shape=(d + 1,),
-                                 value_dtype=np.float32)
-            centroids = kmeans_iteration(
-                engine, centroids, iter_point_chunks(config.input_path, rows))
+        if device_mode:
+            n_shards = effective_num_shards(config)
+            if n_shards > 1:
+                from map_oxidize_tpu.parallel.kmeans import kmeans_fit_sharded
+
+                centroids = kmeans_fit_sharded(
+                    np.asarray(pts, np.float32), centroids,
+                    iters=config.kmeans_iters, num_shards=config.num_shards,
+                    backend=config.backend)
+            else:
+                from map_oxidize_tpu.workloads.kmeans import kmeans_fit_device
+
+                from map_oxidize_tpu.runtime.engine import pick_device
+
+                centroids = kmeans_fit_device(
+                    np.asarray(pts, np.float32), centroids,
+                    iters=config.kmeans_iters,
+                    device=pick_device(config.backend))
+        else:
+            for _ in range(config.kmeans_iters):
+                engine = make_engine(config, SumReducer(),
+                                     value_shape=(d + 1,),
+                                     value_dtype=np.float32)
+                centroids = kmeans_iteration(
+                    engine, centroids,
+                    iter_point_chunks(config.input_path, rows))
     with metrics.phase("write"):
         if config.output_path:
             # write to the EXACT configured path (np.save(str) would append

@@ -32,10 +32,11 @@ class BigramMapper(Mapper):
     def __init__(self, tokenizer: str = "ascii", use_native: bool = True):
         self.tokenizer = tokenizer
         self._native = None
-        if use_native and tokenizer == "ascii":
+        if use_native:
             from map_oxidize_tpu.native import bindings
 
-            self._native = bindings.stream_or_none(ngram=2)
+            self._native = bindings.stream_or_none(ngram=2,
+                                                   tokenizer=tokenizer)
 
     def map_file(self, path: str, chunk_bytes: int, start_offset: int = 0):
         """Native mmap fast path (see WordCountMapper.map_file)."""

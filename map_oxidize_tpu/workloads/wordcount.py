@@ -5,14 +5,16 @@ whitespace-split, lowercase, **no punctuation stripping** ("the," and "the"
 are distinct keys).  Two tokenizer modes:
 
 * ``ascii`` (default): byte-level — split on ASCII whitespace, lowercase
-  ASCII letters.  This is the mode the C++ hot loop accelerates when
-  available; ``bytes.split()`` / ``bytes.lower()`` are its exact Python
-  equivalents, so native and fallback paths stay bit-identical.
+  ASCII letters.  ``bytes.split()`` / ``bytes.lower()`` are the exact Python
+  equivalents of the C++ hot loop, so native and fallback paths stay
+  bit-identical.
 * ``unicode``: decode UTF-8 and use ``str.split()`` / ``str.lower()`` —
   matching Rust ``split_whitespace()`` + ``to_lowercase()`` (main.rs:96-97)
-  for Unicode corpora.  (Known delta: a handful of locale-ish case mappings,
-  e.g. İ, differ between Rust and Python; both are Unicode-correct and no
-  English corpus contains them.)
+  for Unicode corpora.  The C++ loop accelerates this mode too, via a UTF-8
+  transform pass whose tables are generated from Python's own str.lower() /
+  str.isspace() (tests/test_unicode_native.py proves bit-parity).  (Known
+  delta: a handful of locale-ish case mappings, e.g. İ, differ between Rust
+  and Python; both are Unicode-correct and no English corpus contains them.)
 
 The mapper is a *combiner*: it counts within the chunk (as the reference's
 per-chunk ``HashMap`` effectively does) and emits one row per distinct token,
@@ -47,12 +49,13 @@ class WordCountMapper(Mapper):
 
     def __init__(self, tokenizer: str = "ascii", use_native: bool = True):
         self.tokenizer = tokenizer
-        self.use_native = use_native and tokenizer == "ascii"
+        self.use_native = use_native
         self._native = None
         if self.use_native:
             from map_oxidize_tpu.native import bindings
 
-            self._native = bindings.stream_or_none(ngram=1)
+            self._native = bindings.stream_or_none(ngram=1,
+                                                   tokenizer=tokenizer)
 
     def map_file(self, path: str, chunk_bytes: int, start_offset: int = 0):
         """Native mmap fast path: a ``(MapOutput, next_offset)`` generator

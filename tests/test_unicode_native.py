@@ -1,0 +1,160 @@
+"""Native unicode tokenizer mode: parity with the Python unicode fallback.
+
+The reference's ``split_whitespace()`` + ``to_lowercase()`` are Unicode
+(``/root/reference/src/main.rs:96-97``); round 1 shipped unicode only on the
+Python path.  The native mode transforms UTF-8 (Unicode whitespace -> ' ',
+full lowercase incl. CPython's Final_Sigma context rule) and must match
+``chunk.decode('utf-8').lower().split()`` bit for bit — tables are generated
+FROM Python's own str.lower()/str.isspace(), so these tests are the proof the
+transform applies them correctly.
+"""
+
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from map_oxidize_tpu.config import JobConfig
+from map_oxidize_tpu.native.bindings import load_or_none
+from map_oxidize_tpu.ops.hashing import join_u64
+from map_oxidize_tpu.runtime import run_job
+from map_oxidize_tpu.workloads.wordcount import tokenize
+
+native = load_or_none()
+pytestmark = pytest.mark.skipif(native is None, reason="native build unavailable")
+
+
+def _counts(out):
+    k = join_u64(out.hi, out.lo)
+    return {out.dictionary.lookup(int(h)): int(c)
+            for h, c in zip(k.tolist(), out.values.tolist())}
+
+
+CASES = [
+    b"",
+    "Füchse ÜBER den Zaun über FÜCHSE".encode(),
+    "İstanbul STRASSE weiß ÅNGSTRÖM DŽungla".encode(),      # expansions
+    "ΣΟΦΟΣ ΟΔΥΣΣΕΥΣ Σ ΑΣ' Α̇Σ ΑΣ̇Β".encode(),               # final sigma
+    "ideographic　space en quad nbsp".encode(),
+    "seps\x1cand\x1dmore\x1e\x1f done".encode(),            # str.split extras
+    "日本語 中文 mixed ASCII Text 123".encode(),
+    ("x" * 5000 + " Ü " + "y" * 3).encode(),
+    "İİİ oİo".encode(),                 # İ -> i + U+0307
+]
+
+
+@pytest.mark.parametrize("case", CASES, ids=range(len(CASES)))
+def test_unicode_wordcount_parity(case):
+    from map_oxidize_tpu.native.build import NativeStream
+
+    out = NativeStream(1, "unicode").map_chunk(case)
+    want = dict(Counter(tokenize(case, "unicode")))
+    assert _counts(out) == want
+    assert out.records_in == sum(want.values())
+
+
+@pytest.mark.parametrize("case", CASES, ids=range(len(CASES)))
+def test_unicode_bigram_parity(case):
+    from map_oxidize_tpu.native.build import NativeStream
+
+    out = NativeStream(2, "unicode").map_chunk(case)
+    toks = tokenize(case, "unicode")
+    want = dict(Counter(toks[i] + b" " + toks[i + 1]
+                        for i in range(len(toks) - 1)))
+    assert _counts(out) == want
+
+
+def test_unicode_random_fuzz(rng):
+    """Random mixed-script corpora: native == Python on every draw."""
+    from map_oxidize_tpu.native.build import NativeStream
+
+    pool = ("abc ÄÖÜ ß ς Σ σ İ ı 中 文     . , ' ̇ "
+            "Q W ΤΕΛΟΣ λόγος").split(" ")
+    pool += [" ", "\t", "　", "\n"]
+    for _ in range(20):
+        parts = rng.choice(pool, size=rng.integers(0, 200))
+        case = " ".join(parts).encode()
+        out = NativeStream(1, "unicode").map_chunk(case)
+        want = dict(Counter(tokenize(case, "unicode")))
+        assert _counts(out) == want, case
+
+
+def test_invalid_utf8_raises_like_python():
+    from map_oxidize_tpu.native.build import NativeStream
+
+    for bad in (b"ok \xff bad", b"trunc \xc3", b"overlong \xc0\xaf",
+                b"surrogate \xed\xa0\x80", b"stray \x80"):
+        with pytest.raises(UnicodeDecodeError):
+            NativeStream(1, "unicode").map_chunk(bad)
+        with pytest.raises(UnicodeDecodeError):
+            tokenize(bad, "unicode")
+
+
+def test_invalid_utf8_mmap_path_raises_decode_error(tmp_path):
+    """The mmap fast path must raise the same exception TYPE as map_chunk
+    and the Python fallback for invalid UTF-8 (not a generic RuntimeError)."""
+    from map_oxidize_tpu.native.build import NativeStream
+
+    p = tmp_path / "bad.txt"
+    p.write_bytes(b"fine words here \xff broken")
+    it = NativeStream(1, "unicode").iter_file(str(p), 4096)
+    with pytest.raises(UnicodeDecodeError):
+        list(it)
+
+
+def test_hard_cut_backs_off_to_codepoint_boundary(tmp_path):
+    """A whitespace-free window of multi-byte codepoints (CJK joined by
+    U+3000 only) used to hard-cut mid-sequence and abort valid input; the
+    cut must back off to a codepoint boundary and the job must agree with
+    the whole-file Python tokenization (wordcount is chunking-independent)."""
+    from map_oxidize_tpu.native.build import NativeStream
+
+    word = "語言文字處理系統測試"        # 30 UTF-8 bytes, no ASCII at all
+    text = "　".join([word] * 40).encode()  # U+3000 separators only
+    p = tmp_path / "cjk.txt"
+    p.write_bytes(text)
+    def file_counts(chunk_bytes):
+        """Union the per-chunk delta dictionaries, then resolve hashes."""
+        from map_oxidize_tpu.ops.hashing import HashDictionary, join_u64
+
+        d = HashDictionary()
+        by_hash = Counter()
+        for o, _ in NativeStream(1, "unicode").iter_file(str(p), chunk_bytes):
+            d.update(o.dictionary)
+            for h, c in zip(join_u64(o.hi, o.lo).tolist(),
+                            o.values.tolist()):
+                by_hash[h] += c
+        return Counter({d.lookup(h): c for h, c in by_hash.items()})
+
+    want = Counter(tokenize(text, "unicode"))
+    # chunk window smaller than one word forces repeated hard cuts; cuts
+    # split tokens (documented, same as ascii mode), but every piece must be
+    # a VALID utf-8 fragment and the byte mass must conserve
+    got = file_counts(17)
+    assert sum(len(t) * c for t, c in got.items()) == \
+        sum(len(t) * c for t, c in want.items())
+    for tok in got:
+        tok.decode("utf-8")  # no mojibake fragments
+    # with a window bigger than one word, counts match exactly
+    assert file_counts(4096) == want
+
+
+def test_unicode_job_end_to_end(tmp_path, rng):
+    """run_job with tokenizer=unicode rides the native mmap path and matches
+    the pure-Python run exactly (counts and output bytes)."""
+    words = ["Füchse", "ÜBER", "weiß", "ΟΔΥΣΣΕΥΣ", "İzmir", "dog", "the,"]
+    corpus = tmp_path / "u.txt"
+    corpus.write_bytes("\n".join(
+        " ".join(rng.choice(words, size=7)) for _ in range(500)).encode())
+
+    def cfg(**kw):
+        return JobConfig(input_path=str(corpus), tokenizer="unicode",
+                         backend="cpu", num_shards=1, chunk_bytes=4096,
+                         metrics=False, **kw)
+
+    res_native = run_job(cfg(output_path=str(tmp_path / "n.txt"),
+                             mapper="native"), "wordcount")
+    res_python = run_job(cfg(output_path=str(tmp_path / "p.txt"),
+                             mapper="python", use_native=False), "wordcount")
+    assert res_native.counts == res_python.counts
+    assert (tmp_path / "n.txt").read_bytes() == (tmp_path / "p.txt").read_bytes()
