@@ -343,12 +343,10 @@ class NativeStream:
         blob = np.empty(max(nbytes.value, 1), np.uint8)
         self._lib.moxt_dict_read(self._st, hashes.ctypes.data,
                                  lens.ctypes.data, blob.ctypes.data)
-        raw = blob.tobytes()
-        off = 0
-        add = d.add
-        for h, ln in zip(hashes.tolist(), lens.tolist()):
-            add(h, raw[off:off + ln])
-            off += ln
+        # columnar delta, O(1): the per-key materialization loop runs once
+        # at the consumer's first lookup, not per chunk (HashDictionary
+        # docstring) — on wide key spaces this loop was the map-phase tax
+        d.add_arrays(hashes, lens, blob.tobytes())
         return d
 
     def drain_dictionary(self) -> HashDictionary:

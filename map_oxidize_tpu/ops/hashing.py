@@ -125,17 +125,35 @@ class HashDictionary:
     Replaces the reference's reliance on real strings flowing through every
     phase (main.rs:105-107 writes ``"{word} {count}"`` text; main.rs:158-165
     re-parses it).  Here strings stay on the host; only hashes travel.
+
+    Column-delta fast path: the native drain hands back (hashes, lens, blob)
+    arrays; ``add_arrays`` stores them O(1) and materialization (the per-key
+    Python loop, with collision checking) is deferred to the first lookup —
+    so a wide-key-space job (bigram: ~|V|^2 keys) pays the loop ONCE at
+    finalize instead of per chunk per accumulation site.  ``upper_bound()``
+    serves the engine's capacity hints without forcing the flush.
     """
 
-    __slots__ = ("_d",)
+    __slots__ = ("_d", "_pending", "_pending_rows")
 
     def __init__(self) -> None:
         self._d: dict[int, bytes] = {}
+        self._pending: list = []     # (u64 hashes, i64 lens, bytes blob)
+        self._pending_rows = 0
 
     def __len__(self) -> int:
+        self._flush()
         return len(self._d)
 
-    def add(self, h: int, token: bytes) -> None:
+    def upper_bound(self) -> int:
+        """Distinct keys <= this, without materializing pending deltas
+        (pending rows may duplicate existing keys, so this over-counts —
+        safe for capacity hints, which need only an upper bound)."""
+        return len(self._d) + self._pending_rows
+
+    def _add_checked(self, h: int, token: bytes) -> None:
+        """The one collision check-and-insert (every mutation path funnels
+        here so a policy change lands exactly once)."""
         prev = self._d.get(h)
         if prev is None:
             self._d[h] = token
@@ -144,16 +162,79 @@ class HashDictionary:
                 f"64-bit hash collision: {prev!r} and {token!r} both hash to {h:#x}"
             )
 
+    def add(self, h: int, token: bytes) -> None:
+        self._flush()
+        self._add_checked(h, token)
+
+    def add_arrays(self, hashes, lens, blob: bytes) -> None:
+        """Queue a columnar delta (hashes ``u64[n]``, lens ``i64[n]``, token
+        bytes concatenated in order).  O(1); collision checks run at flush."""
+        n = int(len(hashes))
+        if n:
+            self._pending.append((hashes, lens, blob))
+            self._pending_rows += n
+
+    def _flush(self) -> None:
+        if not self._pending:
+            return
+        pend, self._pending, self._pending_rows = self._pending, [], 0
+        add = self._add_checked
+        for hashes, lens, blob in pend:
+            offs = np.zeros(len(lens) + 1, np.int64)
+            np.cumsum(lens, out=offs[1:])
+            ol = offs.tolist()
+            for i, h in enumerate(hashes.tolist()):
+                add(h, bytes(blob[ol[i]:ol[i + 1]]))
+
     def update(self, other: "HashDictionary | dict[int, bytes]") -> None:
-        items = other._d.items() if isinstance(other, HashDictionary) else other.items()
-        for h, tok in items:
-            self.add(h, tok)
+        if isinstance(other, HashDictionary):
+            # SHARE the other side's pending deltas (O(1) per delta; arrays
+            # are never mutated, so aliasing is safe) — our own flush will
+            # materialize + collision-check them.  ``other`` keeps its
+            # deltas: callers may still serialize it afterwards (the
+            # checkpoint spill does exactly that with the per-chunk output).
+            self._pending.extend(other._pending)
+            self._pending_rows += other._pending_rows
+            items = other._d.items()
+        else:
+            items = other.items()
+        if items:
+            self._flush()
+            for h, tok in items:
+                self._add_checked(h, tok)
+
+    def materialized(self) -> dict[int, bytes]:
+        """The flushed hash -> bytes dict (read-only by convention)."""
+        self._flush()
+        return self._d
+
+    def to_arrays(self):
+        """All entries as ``(hashes u64, lens i64, blob u8)`` columns.  A
+        dictionary that is purely one pending delta (the per-chunk native
+        drain) passes its arrays through without materializing — the
+        checkpoint spill path stays O(1) in Python."""
+        if not self._d and len(self._pending) == 1:
+            h, lens, blob = self._pending[0]
+            return (np.ascontiguousarray(h, np.uint64),
+                    np.asarray(lens, np.int64),
+                    np.frombuffer(blob, np.uint8))
+        self._flush()
+        d = self._d
+        hashes = np.fromiter(d.keys(), np.uint64, count=len(d))
+        toks = list(d.values())
+        lens = np.fromiter((len(t) for t in toks), np.int64, count=len(toks))
+        blob = (np.frombuffer(b"".join(toks), np.uint8) if toks
+                else np.empty(0, np.uint8))
+        return hashes, lens, blob
 
     def lookup(self, h: int) -> bytes:
+        self._flush()
         return self._d[h]
 
     def get(self, h: int, default: bytes | None = None) -> bytes | None:
+        self._flush()
         return self._d.get(h, default)
 
     def items(self):
+        self._flush()
         return self._d.items()

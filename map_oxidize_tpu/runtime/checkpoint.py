@@ -50,21 +50,15 @@ _FORMAT_VERSION = 1
 
 
 def _dict_to_arrays(d: HashDictionary):
-    """hash->bytes dict as (hashes u64, lens i64, blob u8) arrays."""
-    hashes = np.fromiter((h for h, _ in d.items()), np.uint64, count=len(d))
-    toks = [t for _, t in d.items()]
-    lens = np.fromiter((len(t) for t in toks), np.int64, count=len(toks))
-    blob = np.frombuffer(b"".join(toks), np.uint8) if toks else np.empty(0, np.uint8)
-    return hashes, lens, blob
+    """hash->bytes dict as (hashes u64, lens i64, blob u8) arrays — O(1)
+    for a pure per-chunk delta (HashDictionary.to_arrays passthrough)."""
+    return d.to_arrays()
 
 
 def _arrays_to_dict(hashes, lens, blob) -> HashDictionary:
     d = HashDictionary()
-    mv = blob.tobytes()
-    off = 0
-    for h, n in zip(hashes.tolist(), lens.tolist()):
-        d.add(int(h), mv[off:off + n])
-        off += n
+    d.add_arrays(np.asarray(hashes, np.uint64), np.asarray(lens, np.int64),
+                 blob.tobytes())
     return d
 
 

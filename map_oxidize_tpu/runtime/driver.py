@@ -81,7 +81,7 @@ def _readback(engine: StreamingEngineBase, dictionary: HashDictionary):
     k64 = join_u64(hi[live], lo[live])
     # high-cardinality workloads make this loop the finalize hot spot — bind
     # the raw dict lookup once (no method dispatch per key)
-    lookup = dictionary._d.__getitem__
+    lookup = dictionary.materialized().__getitem__
     out = {lookup(h): v for h, v in zip(k64.tolist(), vals[live].tolist())}
     if len(out) != n:
         raise RuntimeError(
@@ -133,9 +133,10 @@ def run_wordcount_job(config: JobConfig, mapper: Mapper, reducer: Reducer,
         records_in += out.records_in
         n_chunks += 1
         if mapper.keys_have_dictionary:
-            # the dictionary covers every key fed so far, so its size is
-            # an exact distinct-key bound — growth needs no device sync
-            engine.hint_total_keys(len(dictionary))
+            # the dictionary covers every key fed so far, so its size bounds
+            # distinct keys — growth needs no device sync.  upper_bound
+            # avoids materializing pending column deltas on the feed path.
+            engine.hint_total_keys(dictionary.upper_bound())
         engine.feed(out)
 
     # --- replay checkpointed chunks (resume), if any

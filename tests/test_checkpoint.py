@@ -93,6 +93,41 @@ def test_resume_after_kill_byte_identical(tmp_path, use_native):
     assert not os.path.isdir(ckdir)
 
 
+def test_resume_preserves_prefix_only_words(tmp_path):
+    """Regression: update() must not strip a chunk's pending dictionary
+    delta before the checkpoint spill serializes it.  Words whose ONLY
+    occurrences sit in the replayed prefix can never be re-drained on
+    resume — if the spill lost them, finalize dies on a KeyError."""
+    corpus = tmp_path / "c.txt"
+    with open(corpus, "wb") as f:
+        # unique early vocabulary (first ~3 chunks), disjoint tail vocab
+        for i in range(600):
+            f.write(b"early%04d " % i)
+            if i % 8 == 7:
+                f.write(b"\n")
+        f.write(b"\n")
+        for i in range(600):
+            f.write(b"late%04d " % i)
+            if i % 8 == 7:
+                f.write(b"\n")
+    ckdir = str(tmp_path / "ck")
+    want = run_job(_cfg(corpus, tmp_path / "w.txt", None, use_native=True,
+                        mapper="native", chunk_bytes=2048), "wordcount")
+
+    # native-path run spills every chunk; keep the spill.  With the stolen-
+    # delta bug, every spilled chunk carried an EMPTY dictionary here.
+    run_job(_cfg(corpus, tmp_path / "g.txt", ckdir, use_native=True,
+                 mapper="native", chunk_bytes=2048, keep_intermediates=True),
+            "wordcount")
+    # pure-replay run: every chunk comes from the spill, nothing is
+    # re-mapped, so lost dictionary deltas cannot be re-drained -> KeyError
+    res = run_job(_cfg(corpus, tmp_path / "g2.txt", ckdir, use_native=True,
+                       mapper="native", chunk_bytes=2048,
+                       keep_intermediates=True), "wordcount")
+    assert res.counts == want.counts
+    assert (tmp_path / "g2.txt").read_bytes() == (tmp_path / "w.txt").read_bytes()
+
+
 def test_keep_intermediates_preserves_spill(tmp_path):
     corpus = tmp_path / "corpus.txt"
     _make_corpus(corpus, n_lines=500)
