@@ -146,9 +146,15 @@ class HashDictionary:
         return len(self._d)
 
     def upper_bound(self) -> int:
-        """Distinct keys <= this, without materializing pending deltas
-        (pending rows may duplicate existing keys, so this over-counts —
-        safe for capacity hints, which need only an upper bound)."""
+        """Distinct keys <= this.  Pending rows may duplicate existing keys
+        (multi-worker streams re-drain the shared vocabulary; resume replays
+        plus a fresh stream re-drains), so an unchecked sum would inflate
+        the engine's capacity hint until it stopped ruling growth out and
+        the feed path paid device syncs again.  When duplicates could
+        dominate, flush to re-tighten — total flush work is bounded by total
+        drained rows, the same budget the eager per-chunk loop spent."""
+        if self._pending_rows > max(4096, len(self._d)):
+            self._flush()
         return len(self._d) + self._pending_rows
 
     def _add_checked(self, h: int, token: bytes) -> None:
@@ -171,6 +177,8 @@ class HashDictionary:
         bytes concatenated in order).  O(1); collision checks run at flush."""
         n = int(len(hashes))
         if n:
+            if not isinstance(blob, bytes):
+                blob = bytes(blob)  # so flush-time slices are final copies
             self._pending.append((hashes, lens, blob))
             self._pending_rows += n
 
@@ -184,7 +192,7 @@ class HashDictionary:
             np.cumsum(lens, out=offs[1:])
             ol = offs.tolist()
             for i, h in enumerate(hashes.tolist()):
-                add(h, bytes(blob[ol[i]:ol[i + 1]]))
+                add(h, blob[ol[i]:ol[i + 1]])
 
     def update(self, other: "HashDictionary | dict[int, bytes]") -> None:
         if isinstance(other, HashDictionary):

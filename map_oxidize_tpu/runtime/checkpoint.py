@@ -24,8 +24,9 @@ Layout under ``checkpoint_dir``:
 
 Only the **contiguous** prefix ``chunk_0 .. chunk_{k-1}`` is replayed; later
 files (possible when threaded map completes out of order) are discarded and
-re-mapped.  The dictionary deltas replay in order, so collision detection
-(`HashDictionary.add`) behaves exactly as live.
+re-mapped.  Replayed dictionary deltas are queued as columnar arrays and
+collision-checked at the dictionary's first materialization (finalize) —
+same guarantee as live, deferred like live.
 
 ``keep_intermediates=True`` preserves the directory after success (the
 reference's cleanup always deletes, main.rs:194-202; a failure to delete is a
@@ -47,12 +48,6 @@ from map_oxidize_tpu.utils.logging import get_logger
 _log = get_logger(__name__)
 
 _FORMAT_VERSION = 1
-
-
-def _dict_to_arrays(d: HashDictionary):
-    """hash->bytes dict as (hashes u64, lens i64, blob u8) arrays — O(1)
-    for a pure per-chunk delta (HashDictionary.to_arrays passthrough)."""
-    return d.to_arrays()
 
 
 def _arrays_to_dict(hashes, lens, blob) -> HashDictionary:
@@ -134,7 +129,7 @@ class CheckpointStore:
     def save(self, idx: int, out: MapOutput, next_offset: int) -> None:
         """Atomically persist one mapped chunk (torn files impossible: temp
         file + rename; a crash between the two leaves only the temp)."""
-        hashes, lens, blob = _dict_to_arrays(out.dictionary)
+        hashes, lens, blob = out.dictionary.to_arrays()
         fd, tmp = tempfile.mkstemp(suffix=".npz.tmp", dir=self.dir)
         try:
             with os.fdopen(fd, "wb") as f:
