@@ -64,6 +64,10 @@ class Mapper(abc.ABC):
     #: keyed workloads).  Lets the driver pass the dictionary's exact size to
     #: the engine as a distinct-key bound (no growth syncs, no over-growth).
     keys_have_dictionary: bool = False
+    #: True when Σ emitted values == records_in (count-shaped mappers).  The
+    #: driver's conservation check applies only to sum-reduced mappers with
+    #: this property; set False for sum-of-measurements workloads.
+    conserves_counts: bool = True
 
     @abc.abstractmethod
     def map_chunk(self, chunk: bytes) -> MapOutput:

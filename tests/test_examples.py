@@ -1,0 +1,35 @@
+"""The examples must actually run — a user-defined workload plugged into the
+framework engines (the pluggable boundary the north star names)."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples"))
+
+
+def _write_readings(path, rng, n=2000):
+    cities = [b"Oslo", b"Nairobi", b"Quito", b"Perth", b"Ulan-Bator"]
+    truth = {}
+    with open(path, "wb") as f:
+        for _ in range(n):
+            c = cities[int(rng.integers(0, len(cities)))]
+            t = int(rng.integers(-40, 45))
+            f.write(c + b"," + str(t).encode() + b"\n")
+            truth[c] = min(truth.get(c, 99), t)
+        f.write(b"malformed line no comma\n")   # skipped, like main.rs:160
+        f.write(b"Oslo,notanumber\n")
+    return truth
+
+
+@pytest.mark.parametrize("num_shards", [1, 8])
+def test_min_temperature_by_city(tmp_path, rng, num_shards):
+    from custom_workload import run
+
+    path = tmp_path / "readings.txt"
+    truth = _write_readings(path, rng)
+    got = run(str(path), num_shards=num_shards)
+    assert got == truth
