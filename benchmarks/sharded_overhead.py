@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Characterize the sharded engine's exchange overhead (VERDICT r1 weak #8).
+
+Times the same streaming reduce on the single-device engine vs the sharded
+all_to_all engine across shard counts and bucket_cap settings, on whatever
+backend is available (the 8-virtual-device CPU mesh by default — absolute
+numbers are CPU numbers, but the *ratios* expose the exchange/padding
+overhead the bucket heuristic pays, which is the thing to re-measure when a
+real multi-chip slice exists).
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python benchmarks/sharded_overhead.py
+
+Prints one JSON line per configuration.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from map_oxidize_tpu.api import MapOutput, SumReducer
+from map_oxidize_tpu.config import JobConfig
+from map_oxidize_tpu.ops.hashing import HashDictionary
+from map_oxidize_tpu.runtime.engine import DeviceReduceEngine
+
+
+def _rows(rng, n, key_space):
+    keys = rng.integers(0, key_space, size=n, dtype=np.uint64)
+    vals = rng.integers(1, 10, size=n, dtype=np.int32)
+    hi = (keys >> np.uint64(32)).astype(np.uint32)
+    lo = (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    return hi, lo, vals
+
+
+def time_engine(make, batches, repeats=3):
+    times = []
+    for _ in range(repeats):
+        eng = make()
+        # warm-up feed+finalize OUTSIDE the timed region: each sharded
+        # engine instance builds a fresh jit(shard_map) closure, so without
+        # this every repeat would pay trace/compile inside the timer while
+        # the single engine's module-level jits compile once process-wide
+        hi, lo, vals = batches[0]
+        eng.feed(MapOutput(hi=hi, lo=lo, values=vals,
+                           dictionary=HashDictionary()))
+        eng.finalize()
+        t0 = time.perf_counter()
+        for hi, lo, vals in batches:
+            eng.feed(MapOutput(hi=hi, lo=lo, values=vals,
+                               dictionary=HashDictionary()))
+        eng.finalize()
+        times.append(time.perf_counter() - t0)
+    return min(times)
+
+
+def main():
+    from map_oxidize_tpu.parallel.engine import ShardedReduceEngine
+
+    rng = np.random.default_rng(0)
+    n_batches, batch_rows, key_space = 16, 1 << 16, 50_000
+    batches = [_rows(rng, batch_rows, key_space) for _ in range(n_batches)]
+    rows = n_batches * batch_rows
+
+    cfg = JobConfig(batch_size=batch_rows, key_capacity=1 << 17,
+                    initial_key_capacity=1 << 17, backend="cpu", metrics=False)
+    base = time_engine(lambda: DeviceReduceEngine(cfg, SumReducer()), batches)
+    print(json.dumps({"engine": "single", "shards": 1,
+                      "rows_per_sec": round(rows / base, 1),
+                      "best_s": round(base, 4)}))
+
+    for S in (2, 4, 8):
+        c = JobConfig(batch_size=batch_rows, key_capacity=(1 << 17) * S,
+                      initial_key_capacity=(1 << 17) * S, backend="cpu",
+                      num_shards=S, metrics=False)
+        # expected per-bucket load is (local batch)/S = batch_rows/S^2;
+        # auto is 2x that (+16).  tight probes BELOW auto, wide 2x above.
+        per_bucket = batch_rows // (S * S)
+        for cap_label, cap in (("auto(2x)", 0),
+                               ("tight(1.1x)", int(1.1 * per_bucket) + 1),
+                               ("wide(4x)", 4 * per_bucket + 16)):
+            t = time_engine(
+                lambda: ShardedReduceEngine(c, SumReducer(), bucket_cap=cap),
+                batches)
+            print(json.dumps({
+                "engine": "sharded", "shards": S, "bucket_cap": cap_label,
+                "rows_per_sec": round(rows / t, 1),
+                "best_s": round(t, 4),
+                "vs_single": round(base / t, 3),
+            }))
+
+
+if __name__ == "__main__":
+    main()
