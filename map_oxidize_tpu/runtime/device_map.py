@@ -32,7 +32,7 @@ from map_oxidize_tpu.ops.device_tokenize import (
     pad_chunk,
 )
 from map_oxidize_tpu.ops.hashing import HashDictionary
-from map_oxidize_tpu.runtime.driver import JobResult, _readback, _top_k
+from map_oxidize_tpu.runtime.driver import JobResult, _readback
 from map_oxidize_tpu.runtime.engine import (
     CapacityError,
     DeviceReduceEngine,
@@ -210,10 +210,10 @@ def run_sharded_device_job(config: JobConfig, ngram: int = 1) -> JobResult:
         for d in dicts[1:]:
             dictionary.update(d.dictionary)
         counts = _readback(engine, dictionary)
-        top = _top_k(counts, config.top_k)
+        top = counts.top_k(config.top_k)
 
     records_in = sum(d.records_in for d in dicts)
-    total = sum(counts.values())
+    total = counts.total()
     if records_in and total != records_in:
         raise RuntimeError(
             f"count conservation violated: device tokenized "
@@ -280,9 +280,9 @@ def run_device_wordcount_job(config: JobConfig, ngram: int = 1) -> JobResult:
 
     with metrics.phase("finalize"):
         counts = _readback(engine, dicts.dictionary)
-        top = _top_k(counts, config.top_k)
+        top = counts.top_k(config.top_k)
 
-    total = sum(counts.values())
+    total = counts.total()
     if dicts.records_in and total != dicts.records_in:
         raise RuntimeError(
             f"count conservation violated: device tokenized "

@@ -10,6 +10,7 @@ config, metrics, retries, checkpointing hooks, and deterministic output.
 
 from __future__ import annotations
 
+from collections.abc import Mapping
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -30,9 +31,11 @@ _log = get_logger(__name__)
 @dataclass
 class JobResult:
     """What the reference reports (final_result.txt + top-10 stdout,
-    main.rs:25-28), plus metrics."""
+    main.rs:25-28), plus metrics.  ``counts`` is a read-only Mapping
+    (:class:`LazyCounts`): array-backed until a consumer needs strings for
+    every key."""
 
-    counts: dict[bytes, int]
+    counts: "Mapping[bytes, int]"
     top: list[tuple[bytes, int]]
     metrics: dict = field(default_factory=dict)
 
@@ -70,33 +73,107 @@ def make_engine(config: JobConfig, reducer, value_shape=(), value_dtype=np.int32
                                value_dtype=value_dtype)
 
 
-def _readback(engine: StreamingEngineBase, dictionary: HashDictionary):
-    """Device accumulator -> host {word_bytes: count}.  Padding rows carry
-    the SENTINEL key and may sit anywhere (engine contract), so mask."""
+class LazyCounts(Mapping):
+    """{word_bytes: count} view over the engine's columnar readback.
+
+    The per-key Python loop (hash list -> string lookup -> dict insert) is
+    the finalize hot spot on wide key spaces (bigram: ~|V|^2 keys), yet most
+    of what the driver needs from the counts — the total for conservation,
+    the distinct-key count, the top-k — is answerable from the hash/value
+    ARRAYS plus at most k string lookups.  This Mapping materializes the
+    real dict only when a consumer genuinely needs strings for every key
+    (writing final_result.txt, dict comparisons in tests)."""
+
+    def __init__(self, k64: np.ndarray, vals: np.ndarray,
+                 dictionary: HashDictionary):
+        self._k64 = k64
+        self._vals = vals
+        self._dict = dictionary
+        self._mat: dict[bytes, int] | None = None
+
+    # --- array-answerable queries (no string materialization) ------------
+
+    def __len__(self) -> int:
+        return int(self._k64.shape[0])
+
+    def total(self) -> int:
+        """Σ counts, vectorized (the conservation-check input)."""
+        return int(np.sum(self._vals, dtype=np.int64))
+
+    def top_k(self, k: int) -> list[tuple[bytes, int]]:
+        """Reference top-k (count desc, word asc tie-break): argpartition
+        over the value column, strings materialized only for the <= k
+        winners plus boundary-count ties."""
+        n = len(self)
+        if n == 0:
+            return []
+        vals = self._vals
+        if n <= k:
+            cand = np.arange(n)
+        else:
+            kth = np.partition(vals, n - k)[n - k]
+            cand = np.nonzero(vals >= kth)[0]
+        lookup = self._dict.lookup
+        pairs = [(lookup(int(h)), int(v))
+                 for h, v in zip(self._k64[cand].tolist(),
+                                 vals[cand].tolist())]
+        pairs.sort(key=lambda kv: (-kv[1], kv[0]))
+        return pairs[:k]
+
+    # --- Mapping protocol (materializes) ----------------------------------
+
+    def _materialize(self) -> dict[bytes, int]:
+        if self._mat is None:
+            lookup = self._dict.materialized().__getitem__
+            self._mat = {lookup(h): v for h, v in
+                         zip(self._k64.tolist(), self._vals.tolist())}
+            if len(self._mat) != len(self._k64):
+                raise RuntimeError(
+                    f"readback found {len(self._mat)} distinct words for "
+                    f"{len(self._k64)} live keys")
+        return self._mat
+
+    def __getitem__(self, word: bytes) -> int:
+        return self._materialize()[word]
+
+    def __iter__(self):
+        return iter(self._materialize())
+
+    def __eq__(self, other):
+        if isinstance(other, LazyCounts):
+            other = other._materialize()
+        return self._materialize() == other
+
+    def __ne__(self, other):
+        return not self.__eq__(other)
+
+    def items(self):
+        return self._materialize().items()
+
+
+def _readback(engine: StreamingEngineBase, dictionary: HashDictionary
+              ) -> LazyCounts:
+    """Device accumulator -> :class:`LazyCounts`.  Padding rows carry the
+    SENTINEL key and may sit anywhere (engine contract), so mask."""
     hi, lo, vals, n = engine.finalize()
     hi = np.asarray(hi)
     lo = np.asarray(lo)
     vals = np.asarray(vals)
     live = ~((hi == np.uint32(SENTINEL)) & (lo == np.uint32(SENTINEL)))
     k64 = join_u64(hi[live], lo[live])
-    # high-cardinality workloads make this loop the finalize hot spot — bind
-    # the raw dict lookup once (no method dispatch per key)
-    lookup = dictionary.materialized().__getitem__
-    out = {lookup(h): v for h, v in zip(k64.tolist(), vals[live].tolist())}
-    if len(out) != n:
+    if k64.shape[0] != n:
         raise RuntimeError(
-            f"readback found {len(out)} live keys but engine reported {n}"
+            f"readback found {k64.shape[0]} live keys but engine reported {n}"
         )
-    return out
-
-
-def _top_k(counts: dict[bytes, int], k: int) -> list[tuple[bytes, int]]:
-    """Reference top-k (count desc, word asc tie-break) in O(n log k) — a
-    full sort of a wide key space (bigram: ~|V|^2 keys) costs more than the
-    whole device reduce."""
-    import heapq
-
-    return heapq.nsmallest(k, counts.items(), key=lambda kv: (-kv[1], kv[0]))
+    # a duplicated live key means an engine/exchange bug split one key's
+    # count across rows; the eager dict build used to catch this implicitly,
+    # the lazy view must check it explicitly (vectorized, no strings)
+    if np.unique(k64).shape[0] != n:
+        raise RuntimeError(
+            f"engine emitted duplicate live keys: {n} rows, "
+            f"{np.unique(k64).shape[0]} distinct"
+        )
+    return LazyCounts(k64, vals[live], dictionary)
 
 
 def _track_offsets(chunk_iter, start_off: int, offsets: dict, base_idx: int):
@@ -206,14 +283,14 @@ def run_wordcount_job(config: JobConfig, mapper: Mapper, reducer: Reducer,
     # --- finalize on device; read back to host strings
     with metrics.phase("finalize"):
         counts = _readback(engine, dictionary)
-        top = _top_k(counts, config.top_k)
+        top = counts.top_k(config.top_k)
 
     # conservation check: every token mapped lands in exactly one count
     # (Σ counts == Σ records_in); the reference has no such invariant check.
     # Only meaningful for count-shaped sum workloads — a min/max monoid or a
     # sum of measurements has no such identity.
     if reducer.combine == "sum" and getattr(mapper, "conserves_counts", True):
-        total = sum(counts.values())
+        total = counts.total()
         if records_in and total != records_in:
             raise RuntimeError(
                 f"count conservation violated: mapped {records_in} records "
