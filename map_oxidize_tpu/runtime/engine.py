@@ -96,7 +96,8 @@ class StreamingEngineBase(abc.ABC):
     emitting 30k combined rows must not pay for a 1M-row merge.
 
     Capacity growth: the accumulator starts at ``initial_key_capacity`` and
-    grows by 4x sentinel-pad steps toward ``key_capacity`` (the hard max).
+    grows by sentinel-pad steps (to the needed power of two, 2x minimum)
+    toward ``key_capacity`` (the hard max).
     Growth happens *before* a merge could overflow, driven by a host-tracked
     upper bound on live keys (+= batch rows per merge, no device sync); the
     bound is refreshed from the device's exact count only when it would
@@ -226,10 +227,14 @@ class StreamingEngineBase(abc.ABC):
                 needed = min(needed, self._total_hint)
         if needed <= self.capacity:
             return
-        new_cap = self.capacity
-        while new_cap < needed and new_cap < self.max_capacity:
-            new_cap *= 4
-        new_cap = min(new_cap, self.max_capacity)
+        # grow to the needed power of two (not a blind 4x ladder): with a
+        # distinct-key hint this lands exactly once at the right size, and a
+        # tight capacity keeps the single packed finalize fetch small — the
+        # fetch is capacity-proportional and the link is the scarce resource.
+        # The next-pow2-above-capacity floor keeps un-hinted growth chains
+        # logarithmic without overshooting a hinted exact size.
+        new_cap = min(self.max_capacity,
+                      max(next_pow2(needed), next_pow2(self.capacity + 1)))
         self._apply_grow(new_cap)
         _log.info("accumulator grown %d -> %d rows", self.capacity, new_cap)
         self.capacity = new_cap
