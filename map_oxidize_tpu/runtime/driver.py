@@ -341,7 +341,6 @@ def run_inverted_index_job(config: JobConfig) -> InvertedIndexResult:
     Output file: one line per term, ``term\\td1 d2 d3...``, terms in byte
     order — deterministic, unlike anything the reference's nondeterministic
     HashMap ordering could produce (main.rs:170-182)."""
-    from map_oxidize_tpu.runtime.collect import CollectEngine
     from map_oxidize_tpu.workloads.inverted_index import (
         make_inverted_index,
         postings_from_sorted,
@@ -353,7 +352,14 @@ def run_inverted_index_job(config: JobConfig) -> InvertedIndexResult:
                      "running without")
     metrics = Metrics()
     mapper = make_inverted_index(config.tokenizer, config.use_native)
-    engine = CollectEngine(config)
+    if effective_num_shards(config) > 1:
+        from map_oxidize_tpu.parallel.collect import ShardedCollectEngine
+
+        engine = ShardedCollectEngine(config)
+    else:
+        from map_oxidize_tpu.runtime.collect import CollectEngine
+
+        engine = CollectEngine(config)
     dictionary = HashDictionary()
     records_in = 0
     n_chunks = 0

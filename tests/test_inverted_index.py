@@ -109,3 +109,39 @@ def test_larger_random_corpus(tmp_path, rng):
         lines.append(b" ".join(words[i] for i in rng.integers(0, 80, size=k)))
     p = _write(tmp_path, b"\n".join(lines) + b"\n")
     assert _job_postings(p, chunk_bytes=257) == inverted_index_model(p)
+
+
+def test_sharded_collect_matches_single_device(tmp_path, rng):
+    """Inverted index over the 8-device mesh: hash-routed all_to_all collect
+    + per-shard sort must produce exactly the single-device postings (term
+    segments are disjoint across shards by routing)."""
+    words = ["the", "Fox,", "dog", "jumps", "over", "LAZY", "a", "end."]
+    corpus = tmp_path / "docs.txt"
+    corpus.write_text("\n".join(
+        " ".join(rng.choice(words, size=int(rng.integers(2, 8))))
+        for _ in range(300)))
+
+    def run(shards):
+        cfg = JobConfig(input_path=str(corpus), output_path="",
+                        backend="cpu", num_shards=shards, batch_size=1024,
+                        chunk_bytes=2048, metrics=False)
+        return run_job(cfg, "invertedindex").postings
+
+    single = run(1)
+    sharded = run(8)
+    assert sharded == single
+    assert sharded == inverted_index_model(str(corpus))
+
+
+def test_sharded_collect_skewed_single_term(tmp_path):
+    """Every row routes to ONE bucket (a single hot term): the safe default
+    bucket_cap must absorb it without overflow or loss."""
+    corpus = tmp_path / "hot.txt"
+    corpus.write_bytes(b"hot\n" * 2000)
+    cfg = JobConfig(input_path=str(corpus), output_path="", backend="cpu",
+                    num_shards=8, batch_size=512, chunk_bytes=1024,
+                    metrics=False)
+    res = run_job(cfg, "invertedindex")
+    assert list(res.postings) == [b"hot"]
+    assert res.postings[b"hot"] == sorted(res.postings[b"hot"])
+    assert len(res.postings[b"hot"]) == 2000
