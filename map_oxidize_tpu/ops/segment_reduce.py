@@ -28,29 +28,33 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from map_oxidize_tpu.ops.hashing import SENTINEL
 
-_INT_INFO = {
-    jnp.int32.dtype: (jnp.iinfo(jnp.int32).min, jnp.iinfo(jnp.int32).max),
-    jnp.int64.dtype: (jnp.iinfo(jnp.int64).min, jnp.iinfo(jnp.int64).max),
-    jnp.uint32.dtype: (0, jnp.iinfo(jnp.uint32).max),
-}
+def _identity(combine: str, dtype) -> np.ndarray:
+    """Identity element of the combine monoid, used to fill padding rows.
 
-
-def _identity(combine: str, dtype) -> jnp.ndarray:
-    """Identity element of the combine monoid, used to fill padding rows."""
+    Returned as a host (numpy) scalar: inside a trace it embeds as a
+    constant, and outside one it must NOT touch the default device — a
+    CPU-mesh engine has to be constructible even when the default
+    accelerator is absent or unhealthy (the multichip dryrun contract).
+    Integer min/max identities come from ``jnp.iinfo`` so every integer
+    width gets its true extremum (an ``np.full`` of ±inf would unsafe-cast
+    to 0 and corrupt the monoid).
+    """
     dtype = jnp.dtype(dtype)
     if combine == "sum":
-        return jnp.zeros((), dtype)
-    if combine == "max":
-        lo = _INT_INFO[dtype][0] if dtype in _INT_INFO else -jnp.inf
-        return jnp.full((), lo, dtype)
-    if combine == "min":
-        hi = _INT_INFO[dtype][1] if dtype in _INT_INFO else jnp.inf
-        return jnp.full((), hi, dtype)
-    raise ValueError(f"unknown combine {combine!r}")
+        return np.zeros((), dtype)
+    if combine not in ("min", "max"):
+        raise ValueError(f"unknown combine {combine!r}")
+    if dtype.kind in "iu":
+        info = jnp.iinfo(dtype)
+        val = info.min if combine == "max" else info.max
+    else:
+        val = -np.inf if combine == "max" else np.inf
+    return np.full((), val, dtype)
 
 
 COMBINES = {
@@ -111,11 +115,22 @@ def reduce_pairs(hi, lo, vals, combine: str = "sum"):
     return segment_reduce_sorted(hi_s, lo_s, vals_s, combine)
 
 
-def make_accumulator(capacity: int, val_shape=(), val_dtype=jnp.int32, combine="sum"):
-    """A fresh device accumulator: SENTINEL keys, identity values."""
-    hi = jnp.full((capacity,), SENTINEL, jnp.uint32)
-    lo = jnp.full((capacity,), SENTINEL, jnp.uint32)
-    vals = jnp.full((capacity,) + tuple(val_shape), _identity(combine, val_dtype))
+def make_accumulator(capacity: int, val_shape=(), val_dtype=jnp.int32,
+                     combine="sum", xp=np):
+    """A fresh accumulator: SENTINEL keys, identity values.
+
+    ``xp`` picks the array namespace.  The default (numpy) runs no eager op
+    on the default device — callers ``device_put`` the result onto their own
+    mesh/device, or build it eagerly under ``jax.default_device``.
+    (Previously ``jnp.full`` here materialized on the default accelerator
+    first, which let a sick TPU kill CPU-mesh construction: MULTICHIP_r02
+    root cause.)  Callers already inside a jit trace must pass ``xp=jnp`` so
+    the fill compiles to an on-device broadcast instead of baking
+    capacity-sized host constants into the executable.
+    """
+    hi = xp.full((capacity,), SENTINEL, np.uint32)
+    lo = xp.full((capacity,), SENTINEL, np.uint32)
+    vals = xp.full((capacity,) + tuple(val_shape), _identity(combine, val_dtype))
     return hi, lo, vals
 
 

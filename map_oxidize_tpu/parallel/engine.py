@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import numpy as np
 import jax
+import jax.numpy as jnp
 
 from map_oxidize_tpu.api import Reducer
 from map_oxidize_tpu.config import JobConfig
@@ -69,11 +70,17 @@ class ShardedReduceEngine(StreamingEngineBase):
         self._merge, self._topk, self._grow, self.bucket_cap = build_sharded_ops(
             self.mesh, self.combine, bucket_cap, self.batch_per_shard
         )
-        acc = make_accumulator(
-            self.capacity * self.S, self.value_shape, self.value_dtype,
-            self.combine,
+        # jitted fill with out_shardings: materializes directly on the mesh
+        # (no host buffer over the slow link) and never touches the default
+        # device — the mesh may be virtual CPUs while a sick TPU is default
+        init = jax.jit(
+            lambda: make_accumulator(
+                self.capacity * self.S, self.value_shape, self.value_dtype,
+                self.combine, xp=jnp,
+            ),
+            out_shardings=self._sharding,
         )
-        self._acc = list(jax.device_put(acc, self._sharding))
+        self._acc = list(init())
         # [S] cumulative dropped-row counter (exchange-bucket drops plus
         # accumulator truncation), threaded through every merge
         self._overflow = jax.device_put(

@@ -212,8 +212,10 @@ def build_sharded_ops(mesh, combine: str = "sum", bucket_cap: int = 0,
         from map_oxidize_tpu.ops.segment_reduce import make_accumulator
 
         def _grow(h, l, v):
+            # xp=jnp: this runs inside the jit trace, where the fill must
+            # compile to an on-device broadcast, not a pad-sized constant
             p_h, p_l, p_v = make_accumulator(
-                pad_per_shard, v.shape[1:], v.dtype, combine
+                pad_per_shard, v.shape[1:], v.dtype, combine, xp=jnp
             )
             return (
                 jnp.concatenate([h, p_h]),

@@ -304,24 +304,36 @@ class DeviceReduceEngine(StreamingEngineBase):
         self.feed_batch = config.batch_size
         self.max_capacity = config.key_capacity
         self.capacity = min(config.initial_key_capacity, self.max_capacity)
-        self._acc = list(jax.device_put(
-            make_accumulator(
-                self.capacity, self.value_shape, self.value_dtype, self.combine
-            ),
-            self.device,
-        ))
-        self._ovf = jax.device_put(np.zeros((), np.int32), self.device)
+        # eager jnp fill pinned to the engine's own device: materializes in
+        # place (no host buffer shipped over the slow link) and never touches
+        # the default accelerator, which may be absent/unhealthy when this is
+        # a CPU engine on a TPU host.  The device_put then COMMITS the arrays
+        # to self.device (a no-copy move — they already live there): arrays
+        # made under default_device are uncommitted, and an all-uncommitted
+        # jit (e.g. a growth before the first merge) would dispatch on the
+        # default accelerator again.
+        with jax.default_device(self.device):
+            self._acc = [
+                jax.device_put(a, self.device)
+                for a in make_accumulator(
+                    self.capacity, self.value_shape, self.value_dtype,
+                    self.combine, xp=jnp,
+                )
+            ]
+            self._ovf = jax.device_put(jnp.zeros((), jnp.int32), self.device)
 
     def _read_live(self) -> int:
         return int(self._n_unique)
 
     def _apply_grow(self, new_cap: int) -> None:
         pad = new_cap - self.capacity
-        p = jax.device_put(
-            make_accumulator(pad, self.value_shape, self.value_dtype,
-                             self.combine),
-            self.device,
-        )
+        # fill on the engine's device (no pad-sized host->device transfer),
+        # committed so the concat can never dispatch on the default device
+        with jax.default_device(self.device):
+            p = [jax.device_put(a, self.device)
+                 for a in make_accumulator(pad, self.value_shape,
+                                           self.value_dtype, self.combine,
+                                           xp=jnp)]
         # jitted concat: unjitted op-by-op dispatch costs hundreds of ms per
         # op on a remote-attached device
         self._acc = list(_grow_concat(*self._acc, *p))

@@ -1,0 +1,61 @@
+"""The driver-contract entry points must be hermetic.
+
+``dryrun_multichip`` validates sharding semantics, which are
+platform-independent — so it must pass even when the environment says a TPU
+exists but the TPU is unusable (the MULTICHIP_r01/r02 failure mode: a
+libtpu version mismatch killed a CPU-only correctness check).  These tests
+poison the TPU-related environment and require the dryrun to still go green.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_dryrun(n: int, poison: dict) -> subprocess.CompletedProcess:
+    env = dict(os.environ)
+    # start from a clean slate: drop the conftest's CPU pinning so the
+    # subprocess sees what a driver invocation on a TPU host would see
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("_MOXT_DRYRUN_CHILD", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(poison)
+    code = f"import __graft_entry__ as g; g.dryrun_multichip({n})"
+    return subprocess.run(
+        [sys.executable, "-c", code], env=env, cwd=REPO,
+        capture_output=True, text=True, timeout=600,
+    )
+
+
+@pytest.mark.parametrize("poison", [
+    # driver asks for the TPU platform but no TPU exists on this host
+    {"JAX_PLATFORMS": "tpu"},
+    # libtpu points at garbage — the r02 failure shape
+    {"TPU_LIBRARY_PATH": "/nonexistent/libtpu.so",
+     "PJRT_DEVICE": "TPU"},
+    # axon-style site hook trigger: when its sitecustomize is importable it
+    # re-registers a TPU plugin and overrides jax_platforms; the respawn
+    # must strip the trigger so the child stays CPU-only
+    {"PALLAS_AXON_POOL_IPS": "203.0.113.1"},
+])
+def test_dryrun_survives_sick_tpu_env(poison):
+    res = _run_dryrun(4, poison)
+    assert res.returncode == 0, (
+        f"dryrun died under poisoned env {poison}:\n"
+        f"stdout:\n{res.stdout}\nstderr:\n{res.stderr}"
+    )
+    assert "dryrun_multichip(4): ok" in res.stdout
+    assert "device-map ok" in res.stdout
+
+
+def test_dryrun_respawn_replaces_inherited_device_count():
+    # an inherited force-flag for the WRONG pool size must be replaced,
+    # not duplicated (XLA takes the first occurrence)
+    res = _run_dryrun(2, {
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8"})
+    assert res.returncode == 0, res.stderr
+    assert "dryrun_multichip(2): ok" in res.stdout

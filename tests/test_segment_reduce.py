@@ -157,6 +157,34 @@ def test_merge_overflow_counter(rng):
     assert int(ovf) >= 36
 
 
+def test_identity_extrema_for_all_int_widths():
+    """min/max identities must be the true dtype extremum for EVERY integer
+    width, not just the 32/64-bit ones (an inf fill would unsafe-cast to 0
+    and a padding row could then outrank real all-negative maxima)."""
+    from map_oxidize_tpu.ops.segment_reduce import _identity
+
+    for dt in (np.int8, np.int16, np.int32, np.int64,
+               np.uint8, np.uint16, np.uint32):
+        info = np.iinfo(dt)
+        assert _identity("max", dt) == info.min, dt
+        assert _identity("min", dt) == info.max, dt
+    assert _identity("max", np.float32) == -np.inf
+    assert _identity("min", np.float32) == np.inf
+
+
+def test_reduce_pairs_max_int8_all_negative(rng):
+    """End-to-end guard for the int8 identity: all-negative maxima must
+    survive padding rows."""
+    keys64 = rng.integers(0, 2**62, size=20, dtype=np.uint64)
+    picks = keys64[rng.integers(0, 20, size=200)]
+    vals = rng.integers(-120, -1, size=200).astype(np.int8)
+    hi, lo = split_u64(picks)
+    o = reduce_pairs(jnp.array(hi), jnp.array(lo), jnp.array(vals), "max")
+    got = _device_result_to_dict(*o)
+    assert got == _model_reduce(picks, vals, "max")
+    assert all(v < 0 for v in got.values())
+
+
 def test_top_k_pairs(rng):
     keys64, hi, lo, vals = _random_pairs(rng, 3000, 50)
     o_hi, o_lo, o_vals, n_unique = reduce_pairs(jnp.array(hi), jnp.array(lo), jnp.array(vals))
