@@ -68,6 +68,13 @@ class Mapper(abc.ABC):
     #: driver's conservation check applies only to sum-reduced mappers with
     #: this property; set False for sum-of-measurements workloads.
     conserves_counts: bool = True
+    #: True when distinct keys grow with the input (bigram: ~|V|^2) rather
+    #: than saturating far below it (word count: |V|).  Steers the engine
+    #: choice under ``reduce_mode='auto'``: wide key spaces take the
+    #: collect-then-reduce-once engine, whose cost is one sort, instead of
+    #: the streaming fold, whose accumulator would grow through many
+    #: capacities (one XLA executable each) and re-sort per batch.
+    wide_keys: bool = False
 
     @abc.abstractmethod
     def map_chunk(self, chunk: bytes) -> MapOutput:

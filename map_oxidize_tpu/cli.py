@@ -57,6 +57,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "measured winner on a remote-attached chip)")
     p.add_argument("--no-native", action="store_true",
                    help="disable the C++ tokenizer hot loop")
+    p.add_argument("--reduce-mode", choices=["auto", "fold", "collect"],
+                   default="auto",
+                   help="reduce engine: streaming device fold vs host "
+                        "collect+one-sort (auto: by the workload's key-space "
+                        "width — collect for bigram, fold otherwise)")
+    p.add_argument("--collect-sort", choices=["auto", "host", "device"],
+                   default="auto",
+                   help="inverted-index pair sort placement (auto: host — "
+                        "the measured winner on a remote-attached chip)")
     p.add_argument("--kmeans-k", type=int, default=16,
                    help="k-means cluster count (init: first k points)")
     p.add_argument("--kmeans-iters", type=int, default=1,
@@ -88,6 +97,8 @@ def config_from_args(args: argparse.Namespace) -> JobConfig:
         mapper="python" if args.no_native and args.mapper == "auto"
                else args.mapper,
         use_native=not args.no_native,
+        reduce_mode=args.reduce_mode,
+        collect_sort=args.collect_sort,
         checkpoint_dir=args.checkpoint_dir,
         keep_intermediates=args.keep_intermediates,
         trace_dir=args.trace_dir,

@@ -49,6 +49,15 @@ class JobConfig:
     mapper: str = "auto"
     #: per-chunk unique-key slots for the device mapper output
     device_chunk_keys: int = 1 << 19
+    #: reduce engine choice: 'fold' = streaming device accumulator (narrow
+    #: key spaces), 'collect' = host collect + one vectorized sort/reduce
+    #: (wide key spaces — see runtime/host_reduce.py for the measured
+    #: rationale); 'auto' picks by the mapper's wide_keys declaration
+    reduce_mode: str = "auto"
+    #: inverted-index pair sort: 'host' = np.lexsort (zero link traffic,
+    #: the measured winner on a remote-attached chip), 'device' = XLA sort
+    #: in HBM (wins on local attach); 'auto' = host
+    collect_sort: str = "auto"
     #: output file (reference: "final_result.txt", main.rs:174)
     output_path: str = "final_result.txt"
     #: directory for spill/checkpoint artifacts; None disables checkpointing
@@ -82,6 +91,12 @@ class JobConfig:
         if self.mapper not in ("auto", "device", "native", "python"):
             raise ValueError(
                 f"mapper must be auto|device|native|python, got {self.mapper!r}")
+        if self.reduce_mode not in ("auto", "fold", "collect"):
+            raise ValueError(
+                f"reduce_mode must be auto|fold|collect, got {self.reduce_mode!r}")
+        if self.collect_sort not in ("auto", "host", "device"):
+            raise ValueError(
+                f"collect_sort must be auto|host|device, got {self.collect_sort!r}")
         if self.device_chunk_keys <= 0:
             raise ValueError("device_chunk_keys must be positive")
         if self.num_chunks <= 0 and self.chunk_bytes <= 0:

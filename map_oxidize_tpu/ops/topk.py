@@ -29,3 +29,25 @@ def top_k_pairs(hi, lo, counts, k: int):
 
 #: cached-compile variant for repeated host-driven calls
 top_k_pairs_jit = jax.jit(top_k_pairs, static_argnames="k")
+
+
+def top_k_candidate_indices(vals, k: int):
+    """Host-side top-k candidate set: indices of every value >= the k-th
+    largest (argpartition threshold).
+
+    Returning the full tied boundary — not argpartition's arbitrary top-k
+    subset — is what makes a deterministic tie-break possible: the caller
+    sorts the candidates with its own secondary key (word bytes for the
+    readback views, key hash for the hash-level engines) and truncates to
+    ``k``.  Shared by LazyCounts.top_k, Postings.top_by_df and
+    HostCollectReduceEngine.top_k so the boundary-tie subtlety lives once.
+    """
+    import numpy as np
+
+    n = int(vals.shape[0])
+    if n == 0:
+        return np.empty(0, np.int64)
+    if n <= k:
+        return np.arange(n)
+    kth = np.partition(vals, n - k)[n - k]
+    return np.nonzero(vals >= kth)[0]

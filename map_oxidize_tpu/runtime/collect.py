@@ -47,20 +47,35 @@ def _sort_pairs(stacked):
 
 
 class CollectEngine:
-    """Append-only device collection of (key, doc) pairs + one final sort.
+    """Append-only collection of (key, doc) pairs + one final sort.
 
-    Feed path mirrors StreamingEngineBase's host staging (batched single-put
-    transfers); there is no reduction until finalize, so overflow semantics
-    are simply "HBM is the limit" — ``max_rows`` guards against a runaway
-    job eating the accelerator's memory."""
+    Two sort placements behind one surface (``config.collect_sort``):
+
+    * ``'host'`` (the 'auto' default): pairs stay in host RAM and the one
+      sort is ``np.lexsort`` — zero link traffic.  On the measured
+      deployment the device path ships rows over a ~30 MB/s link twice
+      (feed + sorted fetch, ~0.5 GB each way at a 256MB corpus) to run a
+      sort the host does in seconds; measured 137 s device vs ~15 s host
+      end to end (round 3, benchmarks/RESULTS.md).
+    * ``'device'``: the original HBM path — batched packed transfers, one
+      ``lax.sort`` at finalize.  The right call on a local PCIe/ICI attach
+      where the link is thousands of times faster; kept fully working and
+      opt-in, same policy shape as the mapper's ``auto -> native``.
+
+    ``max_rows`` guards host RAM / HBM against a runaway job either way."""
 
     def __init__(self, config: JobConfig, device=None,
                  max_rows: int = 1 << 27):
         self.config = config
-        self.device = device if device is not None else pick_device(config.backend)
+        self.sort_mode = ("host" if config.collect_sort == "auto"
+                          else config.collect_sort)
+        self.device = None
+        if self.sort_mode == "device":
+            self.device = device if device is not None else pick_device(
+                config.backend)
         self.feed_batch = config.batch_size
         self.max_rows = max_rows
-        self._batches: list = []   # device (4, B) blocks
+        self._batches: list = []   # device (4, B) blocks | host row tuples
         self._batch_rows: list[int] = []  # live rows per block
         self._stage: list = []
         self._staged = 0
@@ -80,11 +95,11 @@ class CollectEngine:
             raise RuntimeError(
                 f"CollectEngine exceeded max_rows={self.max_rows}; "
                 f"shard the job or raise the limit")
-        if self._staged >= self.feed_batch:
+        if self.sort_mode == "device" and self._staged >= self.feed_batch:
             self.flush()
 
     def flush(self) -> None:
-        if not self._staged:
+        if self.sort_mode == "host" or not self._staged:
             return
         hi = np.concatenate([s[0] for s in self._stage])
         lo = np.concatenate([s[1] for s in self._stage])
@@ -104,8 +119,20 @@ class CollectEngine:
             self._batch_rows.append(n)
 
     def finalize(self):
-        """One device sort over everything fed; returns host arrays
+        """One sort over everything fed; returns host arrays
         ``(keys_u64, docs_i64)`` sorted by (key, doc) with padding dropped."""
+        if self.sort_mode == "host":
+            if not self._stage:
+                return np.empty(0, np.uint64), np.empty(0, np.int64)
+            keys = ((np.concatenate([s[0] for s in self._stage])
+                     .astype(np.uint64) << np.uint64(32))
+                    | np.concatenate([s[1] for s in self._stage]))
+            v = np.concatenate([s[2] for s in self._stage])
+            self._stage, self._staged = [], 0
+            docs = ((v[:, 0].astype(np.uint64) << np.uint64(32))
+                    | v[:, 1]).view(np.int64)
+            order = np.lexsort((docs, keys))
+            return keys[order], docs[order]
         self.flush()
         total = sum(self._batch_rows)
         if total == 0:

@@ -58,12 +58,34 @@ def effective_num_shards(config: JobConfig) -> int:
     return n
 
 
-def make_engine(config: JobConfig, reducer, value_shape=(), value_dtype=np.int32
-                ) -> StreamingEngineBase:
-    """Pick the engine for the configured shard count: ``num_shards == 1``
-    (or 0 with one visible device) runs single-chip; anything wider builds a
-    mesh and the all_to_all sharded engine."""
+def make_engine(config: JobConfig, reducer, value_shape=(), value_dtype=np.int32,
+                wide_keys: bool = False):
+    """Pick the engine: shard count selects single-chip vs the all_to_all
+    mesh engine, and ``reduce_mode`` (or the mapper's ``wide_keys``
+    declaration under 'auto') selects the streaming fold vs the host
+    collect-reduce for wide key spaces (single-chip only; the sharded
+    engine hash-partitions the key space, so each shard stays narrow)."""
     n = effective_num_shards(config)
+    mode = config.reduce_mode
+    if mode == "auto":
+        mode = ("collect" if wide_keys and n <= 1 and tuple(value_shape) == ()
+                else "fold")
+    elif mode == "collect" and tuple(value_shape) != ():
+        _log.info("reduce_mode='collect' takes scalar values only; the "
+                  "vector-valued reduce uses the fold engine")
+        mode = "fold"
+    if mode == "collect":
+        if n > 1:
+            _log.info("reduce_mode='collect' is single-chip; the %d-shard "
+                      "mesh engine hash-partitions instead", n)
+        else:
+            from map_oxidize_tpu.runtime.host_reduce import (
+                HostCollectReduceEngine,
+            )
+
+            return HostCollectReduceEngine(config, reducer,
+                                           value_shape=value_shape,
+                                           value_dtype=value_dtype)
     if n <= 1:
         return DeviceReduceEngine(config, reducer, value_shape=value_shape,
                                   value_dtype=value_dtype)
@@ -104,15 +126,12 @@ class LazyCounts(Mapping):
         """Reference top-k (count desc, word asc tie-break): argpartition
         over the value column, strings materialized only for the <= k
         winners plus boundary-count ties."""
-        n = len(self)
-        if n == 0:
+        from map_oxidize_tpu.ops.topk import top_k_candidate_indices
+
+        if len(self) == 0:
             return []
         vals = self._vals
-        if n <= k:
-            cand = np.arange(n)
-        else:
-            kth = np.partition(vals, n - k)[n - k]
-            cand = np.nonzero(vals >= kth)[0]
+        cand = top_k_candidate_indices(vals, k)
         lookup = self._dict.lookup
         pairs = [(lookup(int(h)), int(v))
                  for h, v in zip(self._k64[cand].tolist(),
@@ -199,7 +218,8 @@ def run_wordcount_job(config: JobConfig, mapper: Mapper, reducer: Reducer,
 
     engine = make_engine(config, reducer,
                          value_shape=mapper.value_shape,
-                         value_dtype=mapper.value_dtype)
+                         value_dtype=mapper.value_dtype,
+                         wide_keys=getattr(mapper, "wide_keys", False))
     dictionary = HashDictionary()
     records_in = 0
     n_chunks = 0
@@ -319,17 +339,22 @@ def run_wordcount_job(config: JobConfig, mapper: Mapper, reducer: Reducer,
 
 @dataclass
 class InvertedIndexResult:
-    """Postings plus metrics (the inverted-index analogue of JobResult)."""
+    """Postings plus metrics (the inverted-index analogue of JobResult).
+    ``postings`` is a read-only Mapping (:class:`Postings`): CSR-backed,
+    materializing per-term doc lists only on access."""
 
-    postings: dict[bytes, list[int]]
+    postings: "Mapping[bytes, list[int]]"
     metrics: dict = field(default_factory=dict)
 
     def top_report(self, k: int) -> str:
-        top = sorted(self.postings.items(),
-                     key=lambda kv: (-len(kv[1]), kv[0]))[:k]
+        if hasattr(self.postings, "top_by_df"):
+            top = self.postings.top_by_df(k)
+        else:
+            top = [(t, len(d)) for t, d in sorted(
+                self.postings.items(), key=lambda kv: (-len(kv[1]), kv[0]))[:k]]
         lines = [f"Top {k} terms by document frequency:"]
-        lines += [f"{t.decode('utf-8', 'replace')}: {len(d)} docs"
-                  for t, d in top]
+        lines += [f"{t.decode('utf-8', 'replace')}: {df} docs"
+                  for t, df in top]
         return "\n".join(lines)
 
 
@@ -355,6 +380,10 @@ def run_inverted_index_job(config: JobConfig) -> InvertedIndexResult:
     if effective_num_shards(config) > 1:
         from map_oxidize_tpu.parallel.collect import ShardedCollectEngine
 
+        if config.collect_sort != "auto":
+            _log.info("collect_sort=%r applies to the single-chip engine "
+                      "only; the sharded path sorts per shard on device",
+                      config.collect_sort)
         engine = ShardedCollectEngine(config)
     else:
         from map_oxidize_tpu.runtime.collect import CollectEngine

@@ -1,0 +1,141 @@
+"""Host collect-reduce engine: the wide-key-space counterpart of the
+device fold engine.
+
+The streaming fold (:class:`~map_oxidize_tpu.runtime.engine.DeviceReduceEngine`)
+is built for key spaces far smaller than the token stream — the accumulator
+stays tiny while terabytes flow through, and the handful of static shapes
+compiles once.  A *wide* key space (bigram: ~|V|^2 distinct keys approaching
+the pair count) inverts every term of that trade on the measured deployment:
+
+* the accumulator grows through many capacities, and each (capacity, batch)
+  pair is a fresh XLA executable — measured at ~8 s per compile through the
+  remote-attached terminal, 26 compiles = 207 s of a 241 s bigram run
+  (cProfile, 64MB corpus, round 3);
+* every pair crosses the ~30 MB/s host->device link once on feed and once
+  at the capacity-sized finalize fetch — 0.4 GB each way at 256MB corpus —
+  while the host could sort them in place in seconds;
+* the fold re-sorts capacity+batch rows per merge: with distinct ~ fed,
+  that is O(batches * total log total) against one O(total log total) sort.
+
+So for wide keys the right formulation is collect-then-reduce-ONCE, and on
+a ~30 MB/s link the measured winner for the one reduce is the host itself:
+``np.sort`` + ``reduceat`` over 34M rows costs single-digit seconds and
+zero link traffic.  This engine does exactly that, behind the same
+``feed / finalize / top_k`` surface the drivers already use.  The device
+fold stays the default for narrow keys and is always available via
+``reduce_mode='fold'`` — same policy shape as the mapper's measured
+``auto -> native`` choice (``runtime/__init__.py``).
+
+The reference has no analogue of any of this: its reduce is a single
+mutex-guarded HashMap merge (``/root/reference/src/main.rs:111-150``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from map_oxidize_tpu.api import MapOutput, Reducer
+from map_oxidize_tpu.config import JobConfig
+from map_oxidize_tpu.ops.hashing import join_u64, split_u64
+from map_oxidize_tpu.utils.logging import get_logger
+
+_log = get_logger(__name__)
+
+_UFUNC = {"sum": np.add, "min": np.minimum, "max": np.maximum}
+
+
+class HostCollectReduceEngine:
+    """Collects (key, value) rows on the host; one vectorized sort +
+    segment-``reduceat`` at finalize.
+
+    Scalar values only (the wide-key workloads are count-shaped); vector
+    values keep the fold engine.  ``max_rows`` bounds host memory the same
+    way CollectEngine bounds HBM.
+    """
+
+    def __init__(self, config: JobConfig, reducer: Reducer,
+                 value_shape: tuple = (), value_dtype=np.int32,
+                 max_rows: int = 1 << 28):
+        if tuple(value_shape) != ():
+            raise ValueError("HostCollectReduceEngine takes scalar values; "
+                             "use the fold engine for vector reduces")
+        if reducer.combine not in _UFUNC:
+            raise ValueError(f"unknown combine {reducer.combine!r}")
+        self.config = config
+        self.combine = reducer.combine
+        self.value_dtype = np.dtype(value_dtype)
+        self.max_rows = max_rows
+        self.rows_fed = 0
+        self._keys: list[np.ndarray] = []   # u64 blocks
+        self._vals: list[np.ndarray] = []
+        self._reduced: tuple | None = None
+
+    # the capacity-hint surface is a no-op: there is no device accumulator
+    # to size, and distinct keys are discovered by the one final sort
+    def hint_total_keys(self, n: int) -> None:
+        pass
+
+    def hint_live_upper_bound(self, ub: int) -> None:
+        pass
+
+    def feed(self, out: MapOutput) -> None:
+        n = len(out)
+        self.rows_fed += n
+        if n == 0:
+            return
+        self._keys.append(join_u64(out.hi, out.lo))
+        self._vals.append(np.asarray(out.values, self.value_dtype))
+        if self.rows_fed > self.max_rows:
+            raise RuntimeError(
+                f"HostCollectReduceEngine exceeded max_rows={self.max_rows}; "
+                "shard the job or raise the limit")
+
+    def flush(self) -> None:  # feed is already host-resident
+        pass
+
+    def _reduce(self) -> tuple:
+        if self._reduced is None:
+            if not self._keys:
+                e = np.empty(0, np.uint64)
+                self._reduced = (e, np.empty(0, self.value_dtype))
+            else:
+                keys = np.concatenate(self._keys)
+                vals = np.concatenate(self._vals)
+                self._keys = self._vals = None  # free the blocks
+                order = np.argsort(keys, kind="stable")
+                keys = keys[order]
+                vals = vals[order]
+                bounds = np.flatnonzero(
+                    np.concatenate([[True], keys[1:] != keys[:-1]]))
+                red = _UFUNC[self.combine].reduceat(
+                    vals.astype(np.int64 if self.combine == "sum"
+                                else self.value_dtype), bounds)
+                self._reduced = (keys[bounds],
+                                 red.astype(self.value_dtype, copy=False))
+        return self._reduced
+
+    def finalize(self):
+        """Engine contract: ``(hi, lo, vals, n_unique)``; no padding rows —
+        every returned row is live."""
+        keys, vals = self._reduce()
+        hi, lo = split_u64(keys)
+        return hi, lo, vals, int(keys.shape[0])
+
+    def top_k(self, k: int):
+        """(hi_k, lo_k, vals_k, n_unique) — count-descending, deterministic
+        key-ascending tie-break, mirroring the device engines."""
+        keys, vals = self._reduce()
+        n = int(keys.shape[0])
+        if n == 0:
+            e32 = np.empty(0, np.uint32)
+            return e32, e32, np.empty(0, self.value_dtype), 0
+        from map_oxidize_tpu.ops.topk import top_k_candidate_indices
+
+        k = min(k, n)
+        idx = top_k_candidate_indices(vals, k)
+        # count desc, key-hash asc on ties (no strings at engine level);
+        # int64 negation because -int32.min would overflow
+        order = np.lexsort((keys[idx], -vals[idx].astype(np.int64)))
+        idx = idx[order[:k]]
+        hi, lo = split_u64(keys[idx])
+        return hi, lo, vals[idx], n

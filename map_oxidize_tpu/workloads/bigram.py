@@ -28,6 +28,7 @@ class BigramMapper(Mapper):
     value_shape = ()
     value_dtype = np.int32
     keys_have_dictionary = True
+    wide_keys = True  # distinct pairs ~ |V|^2: collect-reduce territory
 
     def __init__(self, tokenizer: str = "ascii", use_native: bool = True):
         self.tokenizer = tokenizer

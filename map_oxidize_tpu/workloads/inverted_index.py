@@ -22,6 +22,8 @@ distinct set.
 
 from __future__ import annotations
 
+from collections.abc import Mapping
+
 import numpy as np
 
 from map_oxidize_tpu.api import Mapper, MapOutput
@@ -105,22 +107,110 @@ def inverted_index_model(path: str) -> dict[bytes, list[int]]:
     return {t: sorted(s) for t, s in index.items()}
 
 
+class Postings(Mapping):
+    """CSR view over the engine's sorted (key, doc) columns: distinct term
+    hashes + segment offsets + the shared doc column — the index itself, in
+    the columnar form the device produced it.
+
+    A 256MB corpus yields tens of millions of (term, doc) pairs; turning
+    them into a dict of Python int lists costs GBs of boxed objects and
+    seconds of loop time that most consumers (metrics, doc-frequency top-k,
+    the streaming writer) never need.  Like the driver's LazyCounts, this
+    Mapping answers everything it can from the arrays and materializes
+    per-term lists only on access.
+    """
+
+    def __init__(self, keys_sorted: np.ndarray, docs: np.ndarray,
+                 dictionary: HashDictionary):
+        bounds = np.flatnonzero(
+            np.concatenate([[True], keys_sorted[1:] != keys_sorted[:-1]])
+        ) if keys_sorted.shape[0] else np.empty(0, np.int64)
+        #: distinct term hashes.  Sorted within each shard's block but NOT
+        #: globally ascending: the sharded engine concatenates its
+        #: hash-partitions shard-major, so lookups go through a lazy
+        #: hash->row dict, never a binary search.
+        self._terms = keys_sorted[bounds]
+        #: segment offsets: term i's docs are docs[off[i]:off[i+1]]
+        self._offsets = np.append(bounds, keys_sorted.shape[0])
+        self._docs = docs
+        self._dict = dictionary
+        self._index: dict[int, int] | None = None
+
+    # --- array-answerable queries -----------------------------------------
+
+    def __len__(self) -> int:
+        return int(self._terms.shape[0])
+
+    @property
+    def n_pairs(self) -> int:
+        return int(self._docs.shape[0])
+
+    def doc_freqs(self) -> np.ndarray:
+        """Per-term document frequency, vectorized (terms in hash order)."""
+        return np.diff(self._offsets)
+
+    def top_by_df(self, k: int) -> list[tuple[bytes, int]]:
+        """Top-k terms by document frequency (df desc, term asc tie-break);
+        strings materialize only for the <= k winners plus boundary ties."""
+        from map_oxidize_tpu.ops.topk import top_k_candidate_indices
+
+        if len(self) == 0:
+            return []
+        df = self.doc_freqs()
+        cand = top_k_candidate_indices(df, k)
+        lookup = self._dict.lookup
+        pairs = [(lookup(int(h)), int(c))
+                 for h, c in zip(self._terms[cand].tolist(),
+                                 df[cand].tolist())]
+        pairs.sort(key=lambda kv: (-kv[1], kv[0]))
+        return pairs[:k]
+
+    # --- Mapping protocol (per-term materialization) ----------------------
+
+    def _segment(self, i: int) -> list[int]:
+        a, b = int(self._offsets[i]), int(self._offsets[i + 1])
+        return self._docs[a:b].tolist()
+
+    def __getitem__(self, term: bytes) -> list[int]:
+        if self._index is None:
+            self._index = {h: i for i, h in enumerate(self._terms.tolist())}
+        try:
+            i = self._index[moxt64_bytes(term)]
+        except KeyError:
+            raise KeyError(term) from None
+        return self._segment(i)
+
+    def __iter__(self):
+        lookup = self._dict.lookup
+        for h in self._terms.tolist():
+            yield lookup(h)
+
+    def items(self):
+        lookup = self._dict.lookup
+        for i, h in enumerate(self._terms.tolist()):
+            yield lookup(h), self._segment(i)
+
+    def __eq__(self, other):
+        if isinstance(other, Postings):
+            other = dict(other.items())
+        if not isinstance(other, dict):
+            return NotImplemented
+        return len(self) == len(other) and all(
+            t in other and other[t] == d for t, d in self.items()
+        )
+
+    def __ne__(self, other):
+        eq = self.__eq__(other)
+        return eq if eq is NotImplemented else not eq
+
+
 def postings_from_sorted(keys: np.ndarray, docs: np.ndarray,
-                         dictionary: HashDictionary) -> dict[bytes, list[int]]:
-    """Sorted (key, doc) rows -> {term bytes: doc-id list}.  Boundary
-    detection is a vectorized diff, no per-row Python.  (term, doc) pairs
-    are unique by construction: the mapper emits each term once per doc and
-    docs never straddle chunks — newline-aligned cuts guarantee it."""
-    if keys.shape[0] == 0:
-        return {}
-    out: dict[bytes, list[int]] = {}
-    bounds = np.flatnonzero(np.concatenate(
-        [[True], keys[1:] != keys[:-1]]))
-    bounds = np.append(bounds, keys.shape[0])
-    for i in range(bounds.shape[0] - 1):
-        a, b = int(bounds[i]), int(bounds[i + 1])
-        out[dictionary.lookup(int(keys[a]))] = docs[a:b].tolist()
-    return out
+                         dictionary: HashDictionary) -> Postings:
+    """Sorted (key, doc) rows -> :class:`Postings`.  Boundary detection is a
+    vectorized diff, no per-row Python.  (term, doc) pairs are unique by
+    construction: the mapper emits each term once per doc and docs never
+    straddle chunks — newline-aligned cuts guarantee it."""
+    return Postings(keys, docs, dictionary)
 
 
 def make_inverted_index(tokenizer: str = "ascii", use_native: bool = True):

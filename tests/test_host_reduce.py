@@ -1,0 +1,99 @@
+"""Host collect-reduce engine (wide-key-space path) vs the dict model, and
+the reduce_mode routing that selects it."""
+
+import numpy as np
+import pytest
+
+from map_oxidize_tpu.api import MapOutput, MaxReducer, MinReducer, SumReducer
+from map_oxidize_tpu.config import JobConfig
+from map_oxidize_tpu.ops.hashing import HashDictionary, join_u64, split_u64
+from map_oxidize_tpu.runtime.driver import make_engine, run_wordcount_job
+from map_oxidize_tpu.runtime.host_reduce import HostCollectReduceEngine
+from map_oxidize_tpu.workloads.bigram import make_bigram
+
+
+def _feed(engine, keys64, vals):
+    hi, lo = split_u64(keys64)
+    engine.feed(MapOutput(hi=hi, lo=lo, values=vals,
+                          dictionary=HashDictionary()))
+
+
+def _model(keys64, vals, combine):
+    out = {}
+    f = {"sum": lambda a, b: a + b, "min": min, "max": max}[combine]
+    for k, v in zip(keys64.tolist(), vals.tolist()):
+        out[k] = f(out[k], v) if k in out else v
+    return out
+
+
+@pytest.mark.parametrize("reducer", [SumReducer(), MinReducer(), MaxReducer()])
+def test_host_reduce_matches_model(rng, reducer):
+    cfg = JobConfig(num_shards=1, backend="cpu")
+    engine = HostCollectReduceEngine(cfg, reducer)
+    all_k, all_v = [], []
+    for _ in range(5):
+        keys = rng.integers(0, 2**62, size=300, dtype=np.uint64)
+        picks = keys[rng.integers(0, 300, size=2000)]
+        vals = rng.integers(-50, 50, size=2000).astype(np.int32)
+        all_k.append(picks)
+        all_v.append(vals)
+        _feed(engine, picks, vals)
+    hi, lo, vals, n = engine.finalize()
+    got = dict(zip(join_u64(hi, lo).tolist(), vals.tolist()))
+    want = _model(np.concatenate(all_k), np.concatenate(all_v),
+                  reducer.combine)
+    assert got == want and n == len(want)
+
+
+def test_host_reduce_top_k(rng):
+    cfg = JobConfig(num_shards=1, backend="cpu")
+    engine = HostCollectReduceEngine(cfg, SumReducer())
+    keys = rng.integers(0, 2**62, size=40, dtype=np.uint64)
+    picks = keys[rng.integers(0, 40, size=5000)]
+    vals = np.ones(5000, np.int32)
+    _feed(engine, picks, vals)
+    hi, lo, topv, n = engine.top_k(7)
+    model = _model(picks, vals, "sum")
+    want = sorted(model.items(), key=lambda kv: (-kv[1], kv[0]))[:7]
+    got = list(zip(join_u64(hi, lo).tolist(), topv.tolist()))
+    assert got == want and n == len(model)
+
+
+def test_host_reduce_empty():
+    engine = HostCollectReduceEngine(JobConfig(num_shards=1), SumReducer())
+    hi, lo, vals, n = engine.finalize()
+    assert n == 0 and hi.shape == (0,)
+    assert engine.top_k(5)[3] == 0
+
+
+def test_reduce_mode_routing():
+    cfg1 = JobConfig(num_shards=1, backend="cpu")
+    assert isinstance(make_engine(cfg1, SumReducer(), wide_keys=True),
+                      HostCollectReduceEngine)
+    assert not isinstance(make_engine(cfg1, SumReducer(), wide_keys=False),
+                          HostCollectReduceEngine)
+    forced = JobConfig(num_shards=1, backend="cpu", reduce_mode="fold")
+    assert not isinstance(make_engine(forced, SumReducer(), wide_keys=True),
+                          HostCollectReduceEngine)
+
+
+@pytest.mark.parametrize("reduce_mode", ["fold", "collect"])
+def test_bigram_job_both_engines_agree(tmp_path, reduce_mode):
+    """End-to-end bigram through each engine must give identical counts."""
+    p = tmp_path / "c.txt"
+    p.write_bytes(b"a b c a b\nb c d\n" * 50)
+    cfg = JobConfig(input_path=str(p), output_path="", backend="cpu",
+                    num_shards=1, reduce_mode=reduce_mode, metrics=False)
+    mapper, reducer = make_bigram()
+    res = run_wordcount_job(cfg, mapper, reducer, workload="bigram")
+    from collections import Counter
+
+    from map_oxidize_tpu.io.splitter import iter_chunks
+    from map_oxidize_tpu.workloads.wordcount import tokenize
+
+    model = Counter()
+    for chunk in iter_chunks(str(p), cfg.chunk_bytes):
+        toks = tokenize(bytes(chunk))
+        model.update(toks[i] + b" " + toks[i + 1]
+                     for i in range(len(toks) - 1))
+    assert res.counts == dict(model)

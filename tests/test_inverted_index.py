@@ -35,6 +35,15 @@ def _job_postings(path, **kw):
     return run_inverted_index_job(cfg).postings
 
 
+@pytest.mark.parametrize("sort_mode", ["host", "device"])
+def test_collect_sort_modes_match_oracle(tmp_path, sort_mode):
+    """Both sort placements (host lexsort / device lax.sort) must produce
+    the oracle postings through the single-chip engine."""
+    p = _write(tmp_path)
+    got = _job_postings(p, num_shards=1, collect_sort=sort_mode)
+    assert got == inverted_index_model(p)
+
+
 def test_job_matches_oracle(tmp_path):
     p = _write(tmp_path)
     assert _job_postings(p) == inverted_index_model(p)
