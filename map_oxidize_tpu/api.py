@@ -42,6 +42,10 @@ class MapOutput:
     #: number of raw input records the mapper consumed (tokens, points, ...);
     #: powers the Σvalues == Σinputs conservation checks and throughput metrics.
     records_in: int = 0
+    #: optional joined uint64 keys (hi << 32 | lo).  Mappers that already
+    #: hold the 64-bit form may pass it so host-side engines skip the
+    #: join; device engines ignore it (they consume the 32-bit planes).
+    keys64: np.ndarray | None = None
 
     def __len__(self) -> int:
         return int(self.hi.shape[0])

@@ -114,6 +114,27 @@ def _load_lib():
         lib.moxt_map_range_docs.restype = ctypes.c_int64
         lib.moxt_map_range_docs.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                                             ctypes.c_int64, ctypes.c_int64]
+        lib.moxt_map_hashes.restype = ctypes.c_int32
+        lib.moxt_map_hashes.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                        ctypes.c_int64]
+        lib.moxt_hashes_n.restype = ctypes.c_int64
+        lib.moxt_hashes_n.argtypes = [ctypes.c_void_p]
+        lib.moxt_hashes_read.restype = None
+        lib.moxt_hashes_read.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+        lib.moxt_map_range_hashes.restype = ctypes.c_int64
+        lib.moxt_map_range_hashes.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64]
+        lib.moxt_resolve_begin.restype = ctypes.c_int32
+        lib.moxt_resolve_begin.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                           ctypes.c_int64]
+        lib.moxt_resolve_range.restype = ctypes.c_int64
+        lib.moxt_resolve_range.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64]
+        lib.moxt_resolve_found.restype = ctypes.c_int64
+        lib.moxt_resolve_found.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+        lib.moxt_resolve_read.restype = None
+        lib.moxt_resolve_read.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                          ctypes.c_void_p, ctypes.c_void_p]
         _lib = lib
         return _lib
 
@@ -150,6 +171,24 @@ def _unicode_tables():
     scans give P1∧P2 ⇔ c case-ignorable and P1∧¬P2 ⇔ c cased."""
     global _UNICODE_TABLES
     if _UNICODE_TABLES is None:
+        # probing 0x110000 codepoints through str.lower() costs seconds per
+        # process; the result depends only on the interpreter's Unicode
+        # tables, so cache it keyed on the unidata version
+        import sys
+        import unicodedata
+
+        cache = os.path.join(
+            _BUILD_DIR,
+            f"unicode_tables_u{unicodedata.unidata_version}"
+            f"_py{sys.version_info[0]}{sys.version_info[1]}.npz")
+        try:
+            with np.load(cache) as z:
+                _UNICODE_TABLES = tuple(
+                    z[k] for k in ("ws", "cps", "offs", "blob", "cased",
+                                   "ign"))
+            return _UNICODE_TABLES
+        except (OSError, KeyError, ValueError):
+            pass
         ws = np.array([cp for cp in range(0x3001) if chr(cp).isspace()],
                       np.uint32)
         cps, offs, parts = [], [0], []
@@ -180,6 +219,16 @@ def _unicode_tables():
             np.array(cased, np.uint32),
             np.array(ignorable, np.uint32),
         )
+        try:
+            os.makedirs(_BUILD_DIR, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(suffix=".npz.tmp", dir=_BUILD_DIR)
+            with os.fdopen(fd, "wb") as f:
+                t = _UNICODE_TABLES
+                np.savez(f, ws=t[0], cps=t[1], offs=t[2], blob=t[3],
+                         cased=t[4], ign=t[5])
+            os.replace(tmp, cache)
+        except OSError:
+            pass  # cache is best-effort; probing again next process is fine
     return _UNICODE_TABLES
 
 
@@ -330,6 +379,96 @@ class NativeStream:
         finally:
             self._lib.moxt_file_close(f)
 
+    def map_chunk_hashes(self, chunk) -> MapOutput:
+        """Hash-only map of one chunk: one raw n-gram hash per window, no
+        tables, no strings (wide-key collect-reduce path).  Values are all
+        ones; the engine's one final sort aggregates."""
+        view = np.frombuffer(chunk, np.uint8)
+        with self._lock:
+            rc = self._lib.moxt_map_hashes(self._st, view.ctypes.data,
+                                           view.size)
+            return self._collect_hashes_locked(rc)
+
+    def _collect_hashes_locked(self, rc: int) -> MapOutput:
+        _raise_map_error(rc)
+        n = int(self._lib.moxt_hashes_n(self._st))
+        hashes = np.empty(n, np.uint64)
+        if n:
+            self._lib.moxt_hashes_read(self._st, hashes.ctypes.data)
+        hi, lo = split_u64(hashes)
+        return MapOutput(hi=hi, lo=lo, values=np.ones(n, np.int32),
+                         dictionary=HashDictionary(), records_in=n,
+                         keys64=hashes)
+
+    def iter_file_hashes(self, path: str, chunk_bytes: int,
+                         start_offset: int = 0):
+        """mmap hash-only map over a file; same cut policy (and therefore
+        the same resume offsets) as :meth:`iter_file`.  Yields
+        ``(MapOutput, next_offset)``."""
+        f = self._lib.moxt_file_open(os.fsencode(path))
+        if not f:
+            raise OSError(f"cannot open/mmap {path!r}")
+        try:
+            size = int(self._lib.moxt_file_size(f))
+            off = start_offset
+            while off < size:
+                with self._lock:
+                    consumed = int(self._lib.moxt_map_range_hashes(
+                        self._st, f, off, chunk_bytes))
+                    if consumed < 0:
+                        _raise_map_error(-consumed)
+                    if consumed == 0:
+                        raise RuntimeError(
+                            f"native map_range_hashes stalled at {off}")
+                    out = self._collect_hashes_locked(0)
+                off += consumed
+                yield out, off
+        finally:
+            self._lib.moxt_file_close(f)
+
+    def resolve_file(self, path: str, chunk_bytes: int, hashes: np.ndarray):
+        """Recover key bytes for ``hashes`` by rescanning the corpus with
+        the SAME chunk cuts the hash-only map used.  Returns
+        ``(found_hashes u64, lens i32, blob bytes)``; a 64-bit collision
+        involving any queried key raises (first occurrence's bytes are
+        compared against every later occurrence)."""
+        hashes = np.ascontiguousarray(hashes, np.uint64)
+        with self._lock:
+            rc = self._lib.moxt_resolve_begin(
+                self._st, hashes.ctypes.data, hashes.size)
+            if rc:
+                raise RuntimeError(f"moxt_resolve_begin failed ({rc})")
+            if hashes.size == 0:
+                return (np.empty(0, np.uint64), np.empty(0, np.int32), b"")
+            f = self._lib.moxt_file_open(os.fsencode(path))
+            if not f:
+                raise OSError(f"cannot open/mmap {path!r}")
+            try:
+                size = int(self._lib.moxt_file_size(f))
+                off = 0
+                while off < size:
+                    consumed = int(self._lib.moxt_resolve_range(
+                        self._st, f, off, chunk_bytes))
+                    if consumed < 0:
+                        _raise_map_error(-consumed)
+                    if consumed == 0:
+                        raise RuntimeError(
+                            f"native resolve_range stalled at {off}")
+                    off += consumed
+            finally:
+                self._lib.moxt_file_close(f)
+            nbytes = ctypes.c_int64()
+            n = int(self._lib.moxt_resolve_found(self._st,
+                                                 ctypes.byref(nbytes)))
+            out_h = np.empty(n, np.uint64)
+            out_len = np.empty(n, np.int32)
+            blob = np.empty(max(int(nbytes.value), 1), np.uint8)
+            if n:
+                self._lib.moxt_resolve_read(
+                    self._st, out_h.ctypes.data, out_len.ctypes.data,
+                    blob.ctypes.data)
+            return out_h, out_len, blob.tobytes()[:int(nbytes.value)]
+
     def _drain_dict_locked(self) -> HashDictionary:
         n = ctypes.c_int64()
         nbytes = ctypes.c_int64()
@@ -392,6 +531,13 @@ class StreamPool:
 
     def iter_file_docs(self, path: str, chunk_bytes: int):
         return self.get().iter_file_docs(path, chunk_bytes)
+
+    def iter_file_hashes(self, path: str, chunk_bytes: int,
+                         start_offset: int = 0):
+        return self.get().iter_file_hashes(path, chunk_bytes, start_offset)
+
+    def resolve_file(self, path: str, chunk_bytes: int, hashes):
+        return self.get().resolve_file(path, chunk_bytes, hashes)
 
     def close(self) -> None:
         with self._lock:

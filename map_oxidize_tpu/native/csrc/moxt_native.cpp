@@ -363,6 +363,27 @@ struct MoxtState {
   uint64_t* pair_h = nullptr;
   int64_t* pair_doc = nullptr;
   int64_t pair_n = 0, pair_cap = 0;
+  // hash-only mode: raw n-gram hash emission buffer (no tables, no strings)
+  uint64_t* hx_h = nullptr;
+  int64_t hx_n = 0, hx_cap = 0;
+  // hash->bytes resolver: open-addressed query set + found-key storage.
+  // q_ref[j] == -1 means wanted-but-unseen; >= 0 is the resolve_arena
+  // offset of the first matching key's bytes.
+  uint64_t* q_h = nullptr;
+  int64_t* q_ref = nullptr;
+  uint32_t* q_len = nullptr;
+  int64_t q_cap = 0, q_n = 0;
+  int64_t* found = nullptr;     // q-table slots in discovery order
+  int64_t found_n = 0, found_cap = 0;
+  Arena res_arena;
+
+  void hx_push(uint64_t h) {
+    if (hx_n == hx_cap) {
+      hx_cap = hx_cap ? hx_cap * 2 : 1 << 16;
+      hx_h = static_cast<uint64_t*>(realloc(hx_h, hx_cap * 8));
+    }
+    hx_h[hx_n++] = h;
+  }
 
   void pair_push(uint64_t h, int64_t doc) {
     if (pair_n == pair_cap) {
@@ -593,6 +614,94 @@ int64_t transform_unicode(MoxtState* st, const uint8_t* src, int64_t n) {
   return w;
 }
 
+// Shared n-gram scan: tokenize (ascii or unicode-transformed), join each
+// window of `ngram` tokens with single spaces into the key scratch, and
+// hand (key bytes, len, hash) to `emit`.  Emit returns UP_OK or an error
+// code, which aborts the scan.  This is the table-free core that both the
+// hash-only mapper and the hash->bytes resolver run; the classic
+// moxt_map keeps its fused upsert loop (measured: the chunk-table upsert
+// is the part worth fusing, and hash-only mode exists precisely to skip it).
+template <class Emit>
+inline int32_t scan_ngrams(MoxtState* st, const uint8_t* data, int64_t len,
+                           Emit&& emit) {
+  st->n_tokens = 0;
+  if (len <= 0) return 0;
+  if (st->unicode) {
+    int64_t tn = transform_unicode(st, data, len);
+    if (tn < 0) return 3;
+    data = st->utrans;
+    len = tn;
+    if (len <= 0) return 0;
+  }
+  if (len > st->scratch_cap) {
+    free(st->low);
+    free(st->ws);
+    st->low = static_cast<uint8_t*>(malloc(len + 64));
+    st->ws = static_cast<uint64_t*>(malloc((((len + 63) >> 6) + 2) * 8));
+    st->scratch_cap = len;
+  }
+  preprocess(data, len, st->low, st->ws);
+  const uint8_t* low = st->low;
+  const uint64_t* ws = st->ws;
+  const int32_t ngram = st->ngram;
+  if (ngram > 16) return 2;
+
+  struct Span {
+    int64_t at;
+    uint32_t len;
+  };
+  Span ring[16];
+  int32_t filled = 0;
+  int64_t n_tokens = 0;
+  int64_t pos = 0;
+  int rc = UP_OK;
+  while (rc == UP_OK) {
+    int64_t start = next_clear(ws, pos);
+    if (start >= len) break;
+    int64_t end = next_set(ws, start);
+    pos = end + 1;
+    n_tokens++;
+    if (ngram == 1) {
+      uint32_t tlen = (uint32_t)(end - start);
+      uint64_t h;
+      if (tlen <= 16) {
+        uint64_t w0, w1;
+        load16_masked(low + start, tlen, &w0, &w1);
+        h = moxt64_finish(moxt64_round((uint64_t)tlen * kM3, w0, w1));
+      } else {
+        h = moxt64(low + start, tlen);
+      }
+      rc = emit(low + start, tlen, h);
+      continue;
+    }
+    if (filled == ngram) {
+      memmove(ring, ring + 1, (ngram - 1) * sizeof(Span));
+      filled--;
+    }
+    ring[filled].at = start;
+    ring[filled].len = (uint32_t)(end - start);
+    filled++;
+    if (filled < ngram) continue;
+    int64_t klen = ngram - 1;
+    for (int32_t k = 0; k < ngram; k++) klen += ring[k].len;
+    if (klen > st->key_cap) {
+      int64_t nc = st->key_cap ? st->key_cap : 1 << 12;
+      while (nc < klen) nc *= 2;
+      st->key = static_cast<uint8_t*>(realloc(st->key, nc));
+      st->key_cap = nc;
+    }
+    int64_t w = 0;
+    for (int32_t k = 0; k < ngram; k++) {
+      if (k) st->key[w++] = ' ';
+      memcpy(st->key + w, low + ring[k].at, ring[k].len);
+      w += ring[k].len;
+    }
+    rc = emit(st->key, (uint32_t)klen, moxt64(st->key, klen));
+  }
+  st->n_tokens = n_tokens;
+  return rc == UP_OK ? 0 : rc;
+}
+
 }  // namespace
 
 extern "C" {
@@ -608,6 +717,10 @@ int32_t moxt_set_unicode(MoxtState* st, const uint32_t* ws_cps, int64_t n_ws,
                          const uint32_t* ign_cps, int64_t n_ign) {
   if (!st) return 2;
   UnicodeTables& u = st->utab;
+  // idempotent re-call: release any previous tables and clear the ws bitmap
+  // (a second call used to leak the old tables and OR new ws bits in)
+  u.destroy();
+  u = UnicodeTables();
   for (int64_t i = 0; i < n_ws; i++) {
     uint32_t cp = ws_cps[i];
     if (cp > 0x3000) return 2;  // table contract: isspace() max is U+3000
@@ -616,6 +729,7 @@ int32_t moxt_set_unicode(MoxtState* st, const uint32_t* ws_cps, int64_t n_ws,
   constexpr int64_t kBitWords = (0x110000 + 63) / 64;
   u.cased_bits = static_cast<uint64_t*>(calloc(kBitWords, 8));
   u.ign_bits = static_cast<uint64_t*>(calloc(kBitWords, 8));
+  if (!u.cased_bits || !u.ign_bits) return 4;
   for (int64_t i = 0; i < n_cased; i++) {
     uint32_t cp = cased_cps[i];
     if (cp > 0x10FFFF) return 2;
@@ -634,6 +748,7 @@ int32_t moxt_set_unicode(MoxtState* st, const uint32_t* ws_cps, int64_t n_ws,
   u.map_len = static_cast<uint8_t*>(malloc(cap));
   u.blob_n = map_offs[n_map];
   u.blob = static_cast<uint8_t*>(malloc(u.blob_n ? u.blob_n : 1));
+  if (!u.map_cp || !u.map_off || !u.map_len || !u.blob) return 4;
   memcpy(u.blob, map_bytes, u.blob_n);
   for (int64_t i = 0; i < n_map; i++) {
     uint32_t cp = map_cps[i];
@@ -671,6 +786,12 @@ void moxt_free(MoxtState* st) {
   free(st->key);
   free(st->pair_h);
   free(st->pair_doc);
+  free(st->hx_h);
+  free(st->q_h);
+  free(st->q_ref);
+  free(st->q_len);
+  free(st->found);
+  st->res_arena.destroy();
   delete st;
 }
 
@@ -962,13 +1083,12 @@ void moxt_file_close(MoxtFile* f) {
 
 int64_t moxt_file_size(MoxtFile* f) { return f ? f->size : -1; }
 
-// Map one chunk straight from the mapping: [off, off + consumed), where
-// consumed <= want is cut at the last newline in range (falling back to the
-// last ASCII whitespace, then a hard cut — same bounded-carry policy as the
-// Python splitter).  Returns bytes consumed, 0 at EOF, -1 on a map error
-// (read the error via the state's next moxt_map return or this call's sign).
-int64_t moxt_map_range(MoxtState* st, MoxtFile* f, int64_t off, int64_t want) {
-  if (!st || !f || off < 0 || off >= f->size || want <= 0) return 0;
+// Chunk-cut policy for streaming map ranges: cut at the last newline in the
+// window (falling back to the last ASCII whitespace, then a hard cut — same
+// bounded-carry policy as the Python splitter).  Shared by every
+// non-doc-mode range mapper so resume offsets stay identical across them.
+static int64_t range_cut(MoxtState* st, MoxtFile* f, int64_t off,
+                         int64_t want) {
   int64_t len = f->size - off;
   if (len > want) {
     len = want;
@@ -1008,6 +1128,14 @@ int64_t moxt_map_range(MoxtState* st, MoxtFile* f, int64_t off, int64_t want) {
       }
     }
   }
+  return len;
+}
+
+// Map one chunk straight from the mapping: [off, off + consumed).  Returns
+// bytes consumed, 0 at EOF, -rc on a map error.
+int64_t moxt_map_range(MoxtState* st, MoxtFile* f, int64_t off, int64_t want) {
+  if (!st || !f || off < 0 || off >= f->size || want <= 0) return 0;
+  int64_t len = range_cut(st, f, off, want);
   int32_t rc = moxt_map(st, f->data + off, len);
   if (rc != 0) return -(int64_t)rc;
   return len;
@@ -1062,6 +1190,152 @@ void moxt_dict_read(MoxtState* st, uint64_t* hashes, int32_t* lens,
          st->dict_arena.size - st->pending_bytes_from);
   st->pending_from = st->log_n;
   st->pending_bytes_from = st->dict_arena.size;
+}
+
+// ---------------------------------------------------------------------------
+// Hash-only map + hash->bytes resolver.
+//
+// Wide-key workloads routed to the host collect-reduce engine need neither
+// per-chunk combining nor key strings during the map: the one final sort
+// dedups, and strings matter only for the <= k winners (resolved by one
+// extra scan) or a requested full text output.  Dropping the tables removes
+// the map loop's DRAM misses — the chunk/dict tables for millions of
+// distinct bigrams exceed cache, costing ~2 misses per pair — and drops the
+// per-chunk dictionary drain entirely.  Measured on the build host:
+// 21 MB/s (fused upsert map) -> see benchmarks/RESULTS.md for the
+// hash-only number.
+// ---------------------------------------------------------------------------
+
+// Emit one hash per n-gram window into the hash buffer.  0 ok, 3 bad UTF-8.
+int32_t moxt_map_hashes(MoxtState* st, const uint8_t* data, int64_t len) {
+  if (!st || st->error == 2) return 2;
+  st->error = 0;
+  st->hx_n = 0;
+  int32_t rc = scan_ngrams(st, data, len,
+                           [st](const uint8_t*, uint32_t, uint64_t h) {
+                             st->hx_push(h);
+                             return (int)UP_OK;
+                           });
+  if (rc) st->error = rc;
+  return rc;
+}
+
+int64_t moxt_hashes_n(MoxtState* st) { return st->hx_n; }
+
+void moxt_hashes_read(MoxtState* st, uint64_t* out) {
+  memcpy(out, st->hx_h, st->hx_n * 8);
+}
+
+// mmap-range variant; same cut policy as moxt_map_range.
+int64_t moxt_map_range_hashes(MoxtState* st, MoxtFile* f, int64_t off,
+                              int64_t want) {
+  if (!st || !f || off < 0 || off >= f->size || want <= 0) return 0;
+  int64_t len = range_cut(st, f, off, want);
+  int32_t rc = moxt_map_hashes(st, f->data + off, len);
+  if (rc != 0) return -(int64_t)rc;
+  return len;
+}
+
+// Load the query set (the hashes whose key bytes the caller wants back).
+// Resets any previous resolve state.
+int32_t moxt_resolve_begin(MoxtState* st, const uint64_t* hashes, int64_t n) {
+  if (!st) return 2;
+  free(st->q_h);
+  free(st->q_ref);
+  free(st->q_len);
+  free(st->found);
+  st->found = nullptr;
+  st->found_n = st->found_cap = 0;
+  st->res_arena.reset();
+  int64_t cap = 64;
+  while (cap < 4 * n) cap <<= 1;
+  st->q_cap = cap;
+  st->q_n = n;
+  st->q_h = static_cast<uint64_t*>(malloc(cap * 8));
+  st->q_ref = static_cast<int64_t*>(malloc(cap * 8));
+  st->q_len = static_cast<uint32_t*>(malloc(cap * 4));
+  if (!st->q_h || !st->q_ref || !st->q_len) return 2;
+  // q_ref: -2 = empty slot, -1 = wanted/unseen, >=0 = found at arena offset
+  for (int64_t i = 0; i < cap; i++) st->q_ref[i] = -2;
+  for (int64_t i = 0; i < n; i++) {
+    uint64_t h = hashes[i];
+    int64_t j = h & (cap - 1);
+    while (st->q_ref[j] != -2) {
+      if (st->q_h[j] == h) break;  // duplicate query hash: one slot
+      j = (j + 1) & (cap - 1);
+    }
+    st->q_h[j] = h;
+    if (st->q_ref[j] == -2) st->q_ref[j] = -1;
+  }
+  return 0;
+}
+
+// Scan one chunk; record bytes for the first occurrence of each queried
+// hash.  Later occurrences byte-compare against the recorded key, so a
+// 64-bit collision involving any QUERIED key is detected (rc 1) — the same
+// guarantee level the dictionary paths give, scoped to the keys that
+// actually surface.  rc 3 = invalid UTF-8 (unicode mode).
+int32_t moxt_resolve_chunk(MoxtState* st, const uint8_t* data, int64_t len) {
+  if (!st) return 2;
+  if (st->q_n == 0) return 0;
+  uint64_t* qh = st->q_h;
+  int64_t* qref = st->q_ref;
+  uint32_t* qlen = st->q_len;
+  const int64_t mask = st->q_cap - 1;
+  return scan_ngrams(
+      st, data, len,
+      [st, qh, qref, qlen, mask](const uint8_t* key, uint32_t klen,
+                                 uint64_t h) {
+        int64_t j = h & mask;
+        while (qref[j] != -2) {
+          if (qh[j] == h) {
+            if (qref[j] == -1) {
+              qref[j] = st->res_arena.append(key, klen);
+              qlen[j] = klen;
+              if (st->found_n == st->found_cap) {
+                st->found_cap = st->found_cap ? st->found_cap * 2 : 256;
+                st->found = static_cast<int64_t*>(
+                    realloc(st->found, st->found_cap * 8));
+              }
+              st->found[st->found_n++] = j;
+            } else if (qlen[j] != klen ||
+                       memcmp(st->res_arena.data + qref[j], key, klen) != 0) {
+              return (int)UP_COLLISION;
+            }
+            break;
+          }
+          j = (j + 1) & mask;
+        }
+        return (int)UP_OK;
+      });
+}
+
+// mmap-range resolve with the SAME cut policy as the map ranges: a pair
+// counted under the map chunking exists within some map chunk, so scanning
+// identical windows guarantees the resolver sees every counted key.
+int64_t moxt_resolve_range(MoxtState* st, MoxtFile* f, int64_t off,
+                           int64_t want) {
+  if (!st || !f || off < 0 || off >= f->size || want <= 0) return 0;
+  int64_t len = range_cut(st, f, off, want);
+  int32_t rc = moxt_resolve_chunk(st, f->data + off, len);
+  if (rc != 0) return -(int64_t)rc;
+  return len;
+}
+
+// Found-entry drain: count + total bytes, then parallel columns.
+int64_t moxt_resolve_found(MoxtState* st, int64_t* nbytes) {
+  if (nbytes) *nbytes = st->res_arena.size;
+  return st->found_n;
+}
+
+void moxt_resolve_read(MoxtState* st, uint64_t* hashes, int32_t* lens,
+                       uint8_t* bytes) {
+  for (int64_t i = 0; i < st->found_n; i++) {
+    int64_t j = st->found[i];
+    hashes[i] = st->q_h[j];
+    lens[i] = (int32_t)st->q_len[j];
+  }
+  memcpy(bytes, st->res_arena.data, st->res_arena.size);
 }
 
 }  // extern "C"

@@ -37,7 +37,9 @@ from __future__ import annotations
 
 import json
 import os
+import struct
 import tempfile
+import zipfile
 
 import numpy as np
 
@@ -81,8 +83,14 @@ class CheckpointStore:
             os.replace(tmp, self._meta_path)
 
     @staticmethod
-    def job_meta(config, workload: str) -> dict:
-        """The identity key a checkpoint must match to be resumable."""
+    def job_meta(config, workload: str, hash_only: bool = False) -> dict:
+        """The identity key a checkpoint must match to be resumable.
+
+        ``hash_only`` is part of the identity because it changes the SPILL
+        FORMAT: hash-only chunks carry no dictionary strings, so replaying
+        them into a string-draining run (different reduce_mode, no native
+        build, wider device pool) would finalize with missing words.
+        """
         st = os.stat(config.input_path)
         return {
             "input_path": os.path.abspath(config.input_path),
@@ -92,6 +100,7 @@ class CheckpointStore:
             "num_chunks": config.num_chunks,
             "workload": workload,
             "tokenizer": config.tokenizer,
+            "hash_only": bool(hash_only),
         }
 
     def _read_meta(self) -> dict | None:
@@ -127,8 +136,12 @@ class CheckpointStore:
     # --- spill ----------------------------------------------------------
 
     def save(self, idx: int, out: MapOutput, next_offset: int) -> None:
-        """Atomically persist one mapped chunk (torn files impossible: temp
-        file + rename; a crash between the two leaves only the temp)."""
+        """Atomically persist one mapped chunk.  Process crash: temp file +
+        rename means a torn chunk never bears the real name.  Power loss:
+        the fsync before the rename keeps a renamed-but-unwritten file from
+        surviving the journal replay (rename-before-data is a real ext4
+        ordering); replay() additionally treats an unloadable chunk as the
+        end of the contiguous prefix rather than an opaque np.load error."""
         hashes, lens, blob = out.dictionary.to_arrays()
         fd, tmp = tempfile.mkstemp(suffix=".npz.tmp", dir=self.dir)
         try:
@@ -140,6 +153,8 @@ class CheckpointStore:
                     next_offset=np.int64(next_offset),
                     dict_hashes=hashes, dict_lens=lens, dict_blob=blob,
                 )
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, self._chunk_path(idx))
         except BaseException:
             try:
@@ -171,14 +186,30 @@ class CheckpointStore:
                 if idx >= k:
                     os.unlink(os.path.join(self.dir, name))
         for idx in range(k):
-            with np.load(self._chunk_path(idx)) as z:
-                out = MapOutput(
-                    hi=z["hi"], lo=z["lo"], values=z["values"],
-                    dictionary=_arrays_to_dict(
-                        z["dict_hashes"], z["dict_lens"], z["dict_blob"]),
-                    records_in=int(z["records_in"]),
-                )
-                yield idx, out, int(z["next_offset"])
+            try:
+                with np.load(self._chunk_path(idx)) as z:
+                    out = MapOutput(
+                        hi=z["hi"], lo=z["lo"], values=z["values"],
+                        dictionary=_arrays_to_dict(
+                            z["dict_hashes"], z["dict_lens"], z["dict_blob"]),
+                        records_in=int(z["records_in"]),
+                    )
+                    item = (idx, out, int(z["next_offset"]))
+            except (OSError, ValueError, KeyError, EOFError,
+                    zipfile.BadZipFile, struct.error) as e:
+                # a corrupt chunk (e.g. power loss wrote the name but not the
+                # data) ends the usable prefix: drop it and everything after
+                # — those ranges simply re-map
+                _log.warning("checkpoint chunk %d unreadable (%s); resuming "
+                             "from chunk %d and re-mapping the rest", idx, e,
+                             idx)
+                for j in range(idx, k):
+                    try:
+                        os.unlink(self._chunk_path(j))
+                    except OSError:
+                        pass
+                return
+            yield item
 
     # --- lifecycle ------------------------------------------------------
 

@@ -132,7 +132,34 @@ class LazyCounts(Mapping):
             return []
         vals = self._vals
         cand = top_k_candidate_indices(vals, k)
+        prefetch = getattr(self._dict, "prefetch", None)
+        if prefetch is not None:  # hash-only mode: batch-resolve winners
+            prefetch(self._k64[cand])
         lookup = self._dict.lookup
+        if cand.size > max(1024, 32 * k):
+            # boundary-tie flood (Zipf tail: the k-th count is a heavily
+            # tied low value, e.g. 1, and the candidate set approaches the
+            # whole key space).  Strict winners are < k and sort normally;
+            # of the ties only the (k - strict) byte-smallest matter, which
+            # heapq.nsmallest finds in O(M log need) without sorting — or
+            # holding — an M-sized list.  String lookups remain one per tie
+            # (the byte-order tie-break requires them).
+            import heapq
+
+            cvals = vals[cand]
+            kth = cvals.min()
+            strict = cand[cvals > kth]
+            pairs = [(lookup(int(h)), int(v))
+                     for h, v in zip(self._k64[strict].tolist(),
+                                     vals[strict].tolist())]
+            pairs.sort(key=lambda kv: (-kv[1], kv[0]))
+            need = k - len(pairs)
+            if need > 0:
+                ties = cand[cvals == kth]
+                words = heapq.nsmallest(
+                    need, (lookup(int(h)) for h in self._k64[ties].tolist()))
+                pairs += [(w, int(kth)) for w in words]
+            return pairs[:k]
         pairs = [(lookup(int(h)), int(v))
                  for h, v in zip(self._k64[cand].tolist(),
                                  vals[cand].tolist())]
@@ -143,6 +170,9 @@ class LazyCounts(Mapping):
 
     def _materialize(self) -> dict[bytes, int]:
         if self._mat is None:
+            prefetch = getattr(self._dict, "prefetch", None)
+            if prefetch is not None:  # hash-only mode: one resolve-all scan
+                prefetch(self._k64)
             lookup = self._dict.materialized().__getitem__
             self._mat = {lookup(h): v for h, v in
                          zip(self._k64.tolist(), self._vals.tolist())}
@@ -220,7 +250,26 @@ def run_wordcount_job(config: JobConfig, mapper: Mapper, reducer: Reducer,
                          value_shape=mapper.value_shape,
                          value_dtype=mapper.value_dtype,
                          wide_keys=getattr(mapper, "wide_keys", False))
-    dictionary = HashDictionary()
+
+    # hash-only map mode: with the host collect-reduce engine the map needs
+    # neither per-chunk combining nor key strings (the one final sort dedups;
+    # strings resolve later by a same-cuts rescan, RescanDictionary).  Only
+    # the byte-range mmap path qualifies — round-robin chunking has no byte
+    # cuts for the resolver to replay.
+    from map_oxidize_tpu.runtime.host_reduce import HostCollectReduceEngine
+
+    hash_only = (getattr(mapper, "supports_hash_only", False)
+                 and config.num_chunks == 0
+                 and isinstance(engine, HostCollectReduceEngine))
+    if hasattr(mapper, "hash_only"):
+        # assign both ways: a mapper reused across jobs must not keep a
+        # stale True from an earlier collect-engine run
+        mapper.hash_only = hash_only
+    if hash_only:
+        _, rb_chunk = plan_chunks(config.input_path, config.chunk_bytes)
+        dictionary = mapper.rescan_dictionary(config.input_path, rb_chunk)
+    else:
+        dictionary = HashDictionary()
     records_in = 0
     n_chunks = 0
 
@@ -244,8 +293,9 @@ def run_wordcount_job(config: JobConfig, mapper: Mapper, reducer: Reducer,
     if config.checkpoint_dir:
         from map_oxidize_tpu.runtime.checkpoint import CheckpointStore
 
-        ckpt = CheckpointStore(config.checkpoint_dir,
-                               CheckpointStore.job_meta(config, workload))
+        ckpt = CheckpointStore(
+            config.checkpoint_dir,
+            CheckpointStore.job_meta(config, workload, hash_only=hash_only))
         with metrics.phase("replay"):
             for idx, out, next_off in ckpt.replay():
                 _ingest(out)

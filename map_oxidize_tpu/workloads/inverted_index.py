@@ -186,9 +186,11 @@ class Postings(Mapping):
             yield lookup(h)
 
     def items(self):
-        lookup = self._dict.lookup
-        for i, h in enumerate(self._terms.tolist()):
-            yield lookup(h), self._segment(i)
+        """Re-iterable lazy view (NOT a one-shot generator: the Mapping
+        contract allows iterating the same view twice, e.g. a report pass
+        after a write pass).  Each iteration materializes one term's doc
+        list at a time."""
+        return _PostingsItems(self)
 
     def __eq__(self, other):
         if isinstance(other, Postings):
@@ -202,6 +204,24 @@ class Postings(Mapping):
     def __ne__(self, other):
         eq = self.__eq__(other)
         return eq if eq is NotImplemented else not eq
+
+
+class _PostingsItems:
+    """Lazy, re-iterable (term, doc-list) view over a :class:`Postings`."""
+
+    __slots__ = ("_p",)
+
+    def __init__(self, postings: Postings):
+        self._p = postings
+
+    def __len__(self) -> int:
+        return len(self._p)
+
+    def __iter__(self):
+        p = self._p
+        lookup = p._dict.lookup
+        for i, h in enumerate(p._terms.tolist()):
+            yield lookup(h), p._segment(i)
 
 
 def postings_from_sorted(keys: np.ndarray, docs: np.ndarray,

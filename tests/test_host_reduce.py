@@ -77,6 +77,28 @@ def test_reduce_mode_routing():
                           HostCollectReduceEngine)
 
 
+def test_lazycounts_top_k_tie_flood():
+    """A heavily tied k-th value (Zipf tail) must take the capped-candidates
+    branch and still match the full-sort semantics exactly."""
+    from map_oxidize_tpu.ops.hashing import moxt64_bytes
+    from map_oxidize_tpu.runtime.driver import LazyCounts
+
+    d = HashDictionary()
+    words, vals = [], []
+    for i in range(5000):
+        w = b"w%05d" % i
+        h = moxt64_bytes(w)
+        d.add(h, w)
+        words.append(h)
+        vals.append(3 if i in (17, 4321) else 1)  # 2 strict winners, k=5
+    lc = LazyCounts(np.array(words, np.uint64), np.array(vals, np.int32), d)
+    got = lc.top_k(5)
+    want = sorted(((w, v) for w, v in zip(
+        (b"w%05d" % i for i in range(5000)), vals)),
+        key=lambda kv: (-kv[1], kv[0]))[:5]
+    assert got == want
+
+
 @pytest.mark.parametrize("reduce_mode", ["fold", "collect"])
 def test_bigram_job_both_engines_agree(tmp_path, reduce_mode):
     """End-to-end bigram through each engine must give identical counts."""
