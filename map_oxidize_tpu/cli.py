@@ -70,6 +70,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="k-means cluster count (init: first k points)")
     p.add_argument("--kmeans-iters", type=int, default=1,
                    help="k-means iterations")
+    p.add_argument("--dist-coordinator", default="",
+                   help="multi-host: coordination address host:port (same "
+                        "on every process); enables jax.distributed")
+    p.add_argument("--dist-processes", type=int, default=0,
+                   help="multi-host: total process count")
+    p.add_argument("--dist-process-id", type=int, default=-1,
+                   help="multi-host: this process's id (0-based)")
     p.add_argument("--checkpoint-dir", default=None,
                    help="directory for resumable map-output checkpoints")
     p.add_argument("--trace-dir", default=None,
@@ -99,6 +106,9 @@ def config_from_args(args: argparse.Namespace) -> JobConfig:
         use_native=not args.no_native,
         reduce_mode=args.reduce_mode,
         collect_sort=args.collect_sort,
+        dist_coordinator=args.dist_coordinator,
+        dist_num_processes=args.dist_processes,
+        dist_process_id=args.dist_process_id,
         checkpoint_dir=args.checkpoint_dir,
         keep_intermediates=args.keep_intermediates,
         trace_dir=args.trace_dir,
@@ -123,9 +133,34 @@ def main(argv: list[str] | None = None) -> int:
         _log.warning("--keep-intermediates has no effect without "
                      "--checkpoint-dir (there are no intermediates: map "
                      "outputs stay on device)")
-    if config.checkpoint_dir and args.workload in ("kmeans", "invertedindex"):
-        _log.warning("--checkpoint-dir is only wired for wordcount/bigram; "
-                     "%s runs without checkpointing", args.workload)
+    if config.checkpoint_dir and args.workload == "kmeans":
+        _log.warning("--checkpoint-dir is not wired for kmeans; it runs "
+                     "without checkpointing (iterations re-stream the input)")
+
+    if config.dist_coordinator:
+        if args.workload not in ("wordcount", "bigram"):
+            print("error: distributed mode supports wordcount/bigram",
+                  file=sys.stderr)
+            return 2
+        if config.output_path and config.output_path != "final_result.txt":
+            _log.warning("--output is not wired for distributed mode "
+                         "(key strings live in per-process dictionaries); "
+                         "no file will be written")
+        if config.checkpoint_dir:
+            _log.warning("--checkpoint-dir is not wired for distributed "
+                         "mode; running without")
+        from map_oxidize_tpu.parallel.distributed import (
+            init_distributed,
+            run_distributed_wordcount,
+        )
+
+        init_distributed(config.dist_coordinator,
+                         config.dist_num_processes, config.dist_process_id)
+        counts, top = run_distributed_wordcount(config, args.workload)
+        print(f"Top {config.top_k} keys ({len(counts)} distinct):")
+        for h, c in top:
+            print(f"{h:#018x}: {c}")
+        return 0
 
     from map_oxidize_tpu.runtime import run_job
 

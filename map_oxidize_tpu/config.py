@@ -74,6 +74,14 @@ class JobConfig:
     use_native: bool = True
     #: emit per-phase timing/throughput metrics
     metrics: bool = True
+    #: multi-host: coordination-service address ("host:port"); empty = the
+    #: single-process path.  With it set, dist_num_processes and
+    #: dist_process_id select this process's slot; jax.distributed is
+    #: initialized before any backend use and the mesh spans every
+    #: process's devices (ICI within a host, DCN across hosts).
+    dist_coordinator: str = ""
+    dist_num_processes: int = 0
+    dist_process_id: int = -1
     #: k-means: cluster count (init = first k points of the input)
     kmeans_k: int = 16
     #: k-means: iterations to run
@@ -105,4 +113,10 @@ class JobConfig:
             raise ValueError("top_k and num_map_workers must be positive")
         if self.kmeans_k <= 0 or self.kmeans_iters <= 0:
             raise ValueError("kmeans_k and kmeans_iters must be positive")
+        if self.dist_coordinator and (
+                self.dist_num_processes < 2 or self.dist_process_id < 0
+                or self.dist_process_id >= self.dist_num_processes):
+            raise ValueError(
+                "distributed mode needs dist_num_processes >= 2 and "
+                "0 <= dist_process_id < dist_num_processes")
         return self

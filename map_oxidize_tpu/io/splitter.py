@@ -86,7 +86,7 @@ def _last_ws(block) -> int:
     return best
 
 
-def iter_chunks_capped(path: str, chunk_bytes: int):
+def iter_chunks_capped(path: str, chunk_bytes: int, start_offset: int = 0):
     """Yield chunks of AT MOST ``chunk_bytes``, split at whitespace.
 
     For consumers with a fixed-size device buffer (the on-device tokenizer):
@@ -94,8 +94,16 @@ def iter_chunks_capped(path: str, chunk_bytes: int):
     ASCII whitespace is a safe cut point — newline alignment is not needed.
     A single token longer than ``chunk_bytes`` is hard-split (and counted as
     two tokens); at real chunk sizes that means a >32MB whitespace-free run.
+
+    ``start_offset`` resumes at a previous run's cut boundary; the cut policy
+    is deterministic in (offset, chunk_bytes), so the resumed chunk stream
+    equals a fresh run's tail (the snapshot/resume contract).  Chunks are
+    contiguous, so a consumer's next resume offset is its running sum of
+    yielded lengths.
     """
     with open(path, "rb") as f:
+        if start_offset:
+            f.seek(start_offset)
         carry = b""
         while True:
             block = carry + f.read(chunk_bytes - len(carry))
@@ -113,14 +121,21 @@ def iter_chunks_capped(path: str, chunk_bytes: int):
                 carry = block[cut + 1:]
 
 
-def iter_doc_chunks(path: str, chunk_bytes: int) -> Iterator[bytes]:
+def iter_doc_chunks(path: str, chunk_bytes: int,
+                    start_offset: int = 0) -> Iterator[bytes]:
     """Newline-ONLY chunking for document-keyed workloads (inverted index):
     every chunk starts at a line start, so in-chunk byte offsets are valid
     doc ids.  A window with no newline EXTENDS to the next one instead of
     cutting at whitespace — mirroring the native ``moxt_map_range_docs``
-    policy exactly.  Residency is O(longest document)."""
+    policy exactly.  Residency is O(longest document).
+
+    ``start_offset`` resumes at a previous run's chunk boundary (always a
+    line start); the cut policy is deterministic, so the resumed stream is
+    identical to a fresh run's tail — the checkpoint/resume contract."""
     with open(path, "rb") as f:
-        data_pos = 0
+        if start_offset:
+            f.seek(start_offset)
+        data_pos = start_offset
         size = os.fstat(f.fileno()).st_size
         carry = b""
         while data_pos < size or carry:

@@ -355,15 +355,18 @@ class NativeStream:
                 raise RuntimeError(f"native map_docs error {rc}")
             return self._collect_pairs_locked()
 
-    def iter_file_docs(self, path: str, chunk_bytes: int):
+    def iter_file_docs(self, path: str, chunk_bytes: int,
+                       start_offset: int = 0):
         """mmap inverted-index map over a file; doc ids are absolute byte
-        offsets of line starts.  Yields MapOutput per chunk."""
+        offsets of line starts.  Yields ``(MapOutput, next_offset)`` per
+        chunk; ``start_offset`` resumes at a previous run's boundary (the
+        doc-mode cut policy is deterministic in (offset, chunk_bytes))."""
         f = self._lib.moxt_file_open(os.fsencode(path))
         if not f:
             raise OSError(f"cannot open/mmap {path!r}")
         try:
             size = int(self._lib.moxt_file_size(f))
-            off = 0
+            off = start_offset
             while off < size:
                 with self._lock:
                     consumed = int(self._lib.moxt_map_range_docs(
@@ -375,7 +378,7 @@ class NativeStream:
                             f"native map_range_docs stalled at {off}")
                     out = self._collect_pairs_locked()
                 off += consumed
-                yield out
+                yield out, off
         finally:
             self._lib.moxt_file_close(f)
 
@@ -529,8 +532,9 @@ class StreamPool:
     def map_docs(self, chunk, base_doc: int = 0) -> MapOutput:
         return self.get().map_docs(chunk, base_doc)
 
-    def iter_file_docs(self, path: str, chunk_bytes: int):
-        return self.get().iter_file_docs(path, chunk_bytes)
+    def iter_file_docs(self, path: str, chunk_bytes: int,
+                       start_offset: int = 0):
+        return self.get().iter_file_docs(path, chunk_bytes, start_offset)
 
     def iter_file_hashes(self, path: str, chunk_bytes: int,
                          start_offset: int = 0):

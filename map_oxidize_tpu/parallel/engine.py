@@ -124,6 +124,32 @@ class ShardedReduceEngine(StreamingEngineBase):
         )
         self._n_live_ub += incoming
 
+    def export_state(self) -> dict:
+        """Host snapshot of the sharded reduce state (see the single-device
+        twin); arrays are fetched global, restored re-sharded."""
+        return {
+            "acc_hi": np.asarray(self._acc[0]),
+            "acc_lo": np.asarray(self._acc[1]),
+            "acc_vals": np.asarray(self._acc[2]),
+            "ovf": np.asarray(self._overflow),
+            "n_unique": (np.asarray(self._n_unique)
+                         if self._n_unique is not None
+                         else np.full(self.S, -1, np.int32)),
+            "n_live_ub": np.int64(self._n_live_ub),
+            "rows_fed": np.int64(self.rows_fed),
+        }
+
+    def import_state(self, st: dict) -> None:
+        self.capacity = int(st["acc_hi"].shape[0]) // self.S
+        self._acc = [jax.device_put(np.asarray(st[k]), self._sharding)
+                     for k in ("acc_hi", "acc_lo", "acc_vals")]
+        self._overflow = jax.device_put(
+            np.asarray(st["ovf"], np.int32), self._sharding)
+        n = np.asarray(st["n_unique"], np.int32)
+        self._n_unique = None if int(n[0]) < 0 else n
+        self._n_live_ub = int(st["n_live_ub"])
+        self.rows_fed = int(st["rows_fed"])
+
     def _check_health(self) -> None:
         dropped = int(np.asarray(self._overflow)[0])  # host sync
         if dropped:

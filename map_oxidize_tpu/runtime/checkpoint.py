@@ -83,16 +83,21 @@ class CheckpointStore:
             os.replace(tmp, self._meta_path)
 
     @staticmethod
-    def job_meta(config, workload: str, hash_only: bool = False) -> dict:
+    def job_meta(config, workload: str, hash_only: bool = False,
+                 extra: dict | None = None) -> dict:
         """The identity key a checkpoint must match to be resumable.
 
         ``hash_only`` is part of the identity because it changes the SPILL
         FORMAT: hash-only chunks carry no dictionary strings, so replaying
         them into a string-draining run (different reduce_mode, no native
         build, wider device pool) would finalize with missing words.
+        ``extra`` merges path-specific identity keys — the device-map
+        snapshot adds its mesh shape, because an engine state fetched from
+        an S-shard mesh cannot be restored onto a different one (the hash
+        partition assignment is baked into the row layout).
         """
         st = os.stat(config.input_path)
-        return {
+        meta = {
             "input_path": os.path.abspath(config.input_path),
             "input_size": st.st_size,
             "input_mtime_ns": st.st_mtime_ns,
@@ -102,6 +107,9 @@ class CheckpointStore:
             "tokenizer": config.tokenizer,
             "hash_only": bool(hash_only),
         }
+        if extra:
+            meta.update(extra)
+        return meta
 
     def _read_meta(self) -> dict | None:
         try:
@@ -113,6 +121,10 @@ class CheckpointStore:
     def _chunk_path(self, idx: int) -> str:
         return os.path.join(self.dir, f"chunk_{idx:06d}.npz")
 
+    @property
+    def _snapshot_path(self) -> str:
+        return os.path.join(self.dir, "snapshot.npz")
+
     def _clear_chunks(self, strict: bool = False) -> None:
         """Remove all checkpoint artifacts.  ``strict`` raises if a stale
         chunk file survives — required when invalidating another job's spill,
@@ -122,7 +134,7 @@ class CheckpointStore:
         failed = []
         for name in os.listdir(self.dir):
             if (name.startswith("chunk_") or name.startswith("meta.json")
-                    or name.endswith(".tmp")):
+                    or name.startswith("snapshot") or name.endswith(".tmp")):
                 try:
                     os.unlink(os.path.join(self.dir, name))
                 except OSError as e:
@@ -162,6 +174,59 @@ class CheckpointStore:
             except OSError:
                 pass
             raise
+
+    # --- engine-state snapshots (device-map paths) ----------------------
+    #
+    # The device-map drivers never hold map outputs on the host — tokenize,
+    # combine, and the running reduce all live in HBM — so their resumable
+    # artifact is a SNAPSHOT of the reduced state (accumulator planes +
+    # dictionary + input offset), replacing the per-chunk spill.  One file,
+    # atomically replaced; each save supersedes the last.
+
+    def save_snapshot(self, state: dict, dictionary, offset: int,
+                      n_chunks: int, extra: dict | None = None) -> None:
+        hashes, lens, blob = dictionary.to_arrays()
+        payload = {f"eng_{k}": v for k, v in state.items()}
+        payload.update(offset=np.int64(offset), n_chunks=np.int64(n_chunks),
+                       dict_hashes=hashes, dict_lens=lens, dict_blob=blob)
+        for k, v in (extra or {}).items():
+            payload[f"x_{k}"] = v
+        fd, tmp = tempfile.mkstemp(suffix=".npz.tmp", dir=self.dir)
+        try:
+            with os.fdopen(fd, "wb") as f:
+                np.savez(f, **payload)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._snapshot_path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def load_snapshot(self):
+        """Return ``(engine_state, dictionary, offset, n_chunks, extra)`` or
+        None.  A corrupt snapshot (power loss) is discarded — the job simply
+        starts fresh."""
+        try:
+            with np.load(self._snapshot_path) as z:
+                state = {k[4:]: z[k] for k in z.files if k.startswith("eng_")}
+                extra = {k[2:]: z[k] for k in z.files if k.startswith("x_")}
+                d = _arrays_to_dict(z["dict_hashes"], z["dict_lens"],
+                                    z["dict_blob"])
+                return (state, d, int(z["offset"]), int(z["n_chunks"]),
+                        extra)
+        except FileNotFoundError:
+            return None
+        except (OSError, ValueError, KeyError, EOFError,
+                zipfile.BadZipFile, struct.error) as e:
+            _log.warning("snapshot unreadable (%s); starting fresh", e)
+            try:
+                os.unlink(self._snapshot_path)
+            except OSError:
+                pass
+            return None
 
     # --- replay ---------------------------------------------------------
 

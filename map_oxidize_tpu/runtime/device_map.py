@@ -140,9 +140,6 @@ def run_sharded_device_job(config: JobConfig, ngram: int = 1) -> JobResult:
     from map_oxidize_tpu.parallel.mesh import SHARD_AXIS
 
     config.validate()
-    if config.checkpoint_dir:
-        _log.warning("checkpointing is not wired for the device map path; "
-                     "running without (use mapper='native' to checkpoint)")
     metrics = Metrics()
     N = config.chunk_bytes
     max_tokens = N // 2 + 1
@@ -175,6 +172,16 @@ def run_sharded_device_job(config: JobConfig, ngram: int = 1) -> JobResult:
     pending: tuple | None = None
     n_chunks = 0
 
+    ckpt = _open_snapshot(config, f"device-map-sharded-ngram{ngram}", S)
+
+    def _set_dict(d, records):
+        # the snapshot stores the UNION dictionary; shard 0 carries it on
+        # resume (finalize unions the builders anyway)
+        dicts[0].dictionary = d
+        dicts[0].records_in = records
+
+    resume_off, n_chunks = _resume_snapshot(ckpt, engine, _set_dict)
+
     def _process_group(chunks: list[bytes], outs) -> None:
         u_hi, u_lo, reps, packed_dev = outs
         packed = np.asarray(packed_dev).reshape(S, -1)  # ONE fetch per group
@@ -186,18 +193,36 @@ def run_sharded_device_job(config: JobConfig, ngram: int = 1) -> JobResult:
                     u_lo[s * out_keys:(s + 1) * out_keys],
                     reps[s * out_keys:(s + 1) * out_keys], nu))
 
+    def _snapshot(off: int) -> None:
+        union = HashDictionary()
+        for d in dicts:
+            union.update(d.dictionary)
+        ckpt.save_snapshot(
+            engine.export_state(), union, off, n_chunks,
+            {"records_in": np.int64(sum(d.records_in for d in dicts))})
+
     with metrics.phase("map+reduce"):
         group: list[bytes] = []
-        for chunk in iter_chunks_capped(config.input_path, config.chunk_bytes):
+        off = resume_off
+        groups_done = 0
+        for chunk in iter_chunks_capped(config.input_path, config.chunk_bytes,
+                                        resume_off):
             group.append(bytes(chunk))
             n_chunks += 1
+            off += len(chunk)
             if len(group) < S:
                 continue
             pending = _dispatch_group(group, group_fn, N, tables, engine,
                                       row_spec, pending, _process_group)
             group = []
+            groups_done += 1
             engine.hint_live_upper_bound(
                 sum(len(d.dictionary) for d in dicts) + 2 * S * out_keys)
+            if ckpt is not None and groups_done % _SNAP_EVERY == 0:
+                if pending is not None:
+                    _process_group(*pending)  # sync dictionaries
+                    pending = None
+                _snapshot(off)
         if group:  # short tail group: pad with empty (all-space) chunks
             group += [b""] * (S - len(group))
             pending = _dispatch_group(group, group_fn, N, tables, engine,
@@ -224,6 +249,9 @@ def run_sharded_device_job(config: JobConfig, ngram: int = 1) -> JobResult:
         if config.output_path:
             write_final_result(config.output_path, counts.items())
 
+    if ckpt is not None:
+        ckpt.finish(config.keep_intermediates)
+
     metrics.set("records_in", records_in)
     metrics.set("distinct_keys", len(counts))
     metrics.set("chunks", n_chunks)
@@ -248,33 +276,90 @@ def _dispatch_group(group, group_fn, chunk_bytes, tables, engine, row_spec,
     return (group, (u_hi, u_lo, reps, packed))
 
 
+#: snapshot cadence for the device-map checkpoint (chunks between engine
+#: state spills); each snapshot serializes the pipeline for one dictionary
+#: fetch, so the cadence trades resume granularity against overlap
+_SNAP_EVERY = 16
+
+
+def _open_snapshot(config: JobConfig, workload_tag: str, num_shards: int):
+    """Device-map checkpointing: map outputs never exist on the host here,
+    so the resumable artifact is a periodic SNAPSHOT of the reduced state
+    (engine accumulator + dictionary + input byte offset) rather than the
+    host paths' per-chunk spill.  The mesh shape is part of the identity:
+    an S-shard engine state cannot be restored onto a different mesh (the
+    hash partition is baked into the row layout), so a shard-count change
+    discards the snapshot and re-maps from scratch."""
+    if not config.checkpoint_dir:
+        return None
+    from map_oxidize_tpu.runtime.checkpoint import CheckpointStore
+
+    return CheckpointStore(
+        config.checkpoint_dir,
+        CheckpointStore.job_meta(
+            config, workload_tag,
+            extra={"num_shards": num_shards,
+                   "device_chunk_keys": config.device_chunk_keys}))
+
+
+def _resume_snapshot(ckpt, engine, set_dictionary) -> tuple[int, int]:
+    """Shared snapshot-restore: import engine state, hand the union
+    dictionary + prior records_in to ``set_dictionary``, return
+    ``(resume_offset, n_chunks)`` (0, 0 when there is nothing to resume)."""
+    if ckpt is None:
+        return 0, 0
+    snap = ckpt.load_snapshot()
+    if snap is None:
+        return 0, 0
+    state, d, resume_off, n_chunks, extra = snap
+    engine.import_state(state)
+    set_dictionary(d, int(extra["records_in"]))
+    _log.info("resumed device-map snapshot: %d chunks, offset %d",
+              n_chunks, resume_off)
+    return resume_off, n_chunks
+
+
 def run_device_wordcount_job(config: JobConfig, ngram: int = 1) -> JobResult:
     """Word/n-gram count with the map phase on device (single chip)."""
     config.validate()
-    if config.checkpoint_dir:
-        _log.warning("checkpointing is not wired for the device map path; "
-                     "running without (use mapper='native' to checkpoint)")
     metrics = Metrics()
     engine = DeviceReduceEngine(config, SumReducer())
     tok = DeviceTokenizer(config.chunk_bytes, config.device_chunk_keys,
                           device=engine.device, ngram=ngram)
     dicts = _DictBuilder(tok.out_keys, tok.fetch_keys, ngram)
 
+    ckpt = _open_snapshot(config, f"device-map-ngram{ngram}", 1)
+
+    def _set_dict(d, records):
+        dicts.dictionary = d
+        dicts.records_in = records
+        engine.hint_live_upper_bound(len(d))
+
+    resume_off, n_chunks = _resume_snapshot(ckpt, engine, _set_dict)
+
     pending: tuple | None = None
-    n_chunks = 0
+    off = resume_off
     with metrics.phase("map+reduce"):
-        for chunk in iter_chunks_capped(config.input_path, config.chunk_bytes):
+        for chunk in iter_chunks_capped(config.input_path, config.chunk_bytes,
+                                        resume_off):
             outs = tok.map_chunk_device(chunk)          # async upload + kernel
             engine.feed_device(outs[0], outs[1], outs[2])  # async merge
             if pending is not None:
                 dicts.process(*pending)   # blocks; overlaps current compute
             pending = (chunk, outs)
             n_chunks += 1
+            off += len(chunk)
             # the dictionary length is the exact global distinct-key count
             # (one chunk behind) — feed it back so capacity growth rarely
             # needs its own device sync
             engine.hint_live_upper_bound(
                 len(dicts.dictionary) + config.device_chunk_keys)
+            if ckpt is not None and n_chunks % _SNAP_EVERY == 0:
+                dicts.process(*pending)  # sync the dictionary to the engine
+                pending = None
+                ckpt.save_snapshot(
+                    engine.export_state(), dicts.dictionary, off, n_chunks,
+                    {"records_in": np.int64(dicts.records_in)})
         if pending is not None:
             dicts.process(*pending)
 
@@ -292,6 +377,9 @@ def run_device_wordcount_job(config: JobConfig, ngram: int = 1) -> JobResult:
     with metrics.phase("write"):
         if config.output_path:
             write_final_result(config.output_path, counts.items())
+
+    if ckpt is not None:
+        ckpt.finish(config.keep_intermediates)
 
     metrics.set("records_in", dicts.records_in)
     metrics.set("distinct_keys", len(counts))

@@ -377,6 +377,34 @@ class DeviceReduceEngine(StreamingEngineBase):
         )
         self._n_live_ub += incoming
 
+    def export_state(self) -> dict:
+        """Host snapshot of the device reduce state (the device-map paths'
+        checkpoint unit: map outputs never exist on the host there, so the
+        resumable artifact is the reduced state itself)."""
+        return {
+            "acc_hi": np.asarray(self._acc[0]),
+            "acc_lo": np.asarray(self._acc[1]),
+            "acc_vals": np.asarray(self._acc[2]),
+            "ovf": np.asarray(self._ovf),
+            "n_unique": np.asarray(
+                self._n_unique if self._n_unique is not None else -1),
+            "n_live_ub": np.int64(self._n_live_ub),
+            "rows_fed": np.int64(self.rows_fed),
+        }
+
+    def import_state(self, st: dict) -> None:
+        """Restore a snapshot onto this engine's device (committed, like
+        construction)."""
+        self.capacity = int(st["acc_hi"].shape[0])
+        self._acc = [jax.device_put(np.asarray(st[k]), self.device)
+                     for k in ("acc_hi", "acc_lo", "acc_vals")]
+        self._ovf = jax.device_put(
+            np.asarray(st["ovf"], np.int32), self.device)
+        n = int(st["n_unique"])
+        self._n_unique = None if n < 0 else np.int32(n)
+        self._n_live_ub = int(st["n_live_ub"])
+        self.rows_fed = int(st["rows_fed"])
+
     def _check_health(self) -> None:
         dropped = int(self._ovf)  # host sync point
         if dropped:

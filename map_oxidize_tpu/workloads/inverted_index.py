@@ -52,12 +52,14 @@ class InvertedIndexMapper(Mapper):
             return self._native.map_docs(chunk, base_doc)
         return self._map_docs_python(chunk, base_doc)
 
-    def iter_file_docs(self, path: str, chunk_bytes: int):
-        """Native mmap fast path, or None (driver falls back to the
-        splitter + map_docs with host-tracked offsets)."""
+    def iter_file_docs(self, path: str, chunk_bytes: int,
+                       start_offset: int = 0):
+        """Native mmap fast path yielding ``(MapOutput, next_offset)``, or
+        None (driver falls back to the splitter + map_docs with host-tracked
+        offsets)."""
         if self._native is None:
             return None
-        return self._native.iter_file_docs(path, chunk_bytes)
+        return self._native.iter_file_docs(path, chunk_bytes, start_offset)
 
     def map_chunk(self, chunk) -> MapOutput:  # Mapper ABC
         raise NotImplementedError(

@@ -1,0 +1,99 @@
+"""Multi-host execution: two real OS processes, one global 8-device CPU
+mesh (4 local devices each), Gloo collectives over the coordination
+service — the DCN path SURVEY §2 promises, without pod hardware.
+
+Each process maps its chunk subset, the lockstep feed assembles global
+batches with make_array_from_process_local_data, the all_to_all exchange
+routes keys across the process boundary, and both processes must read back
+identical, oracle-exact counts."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHILD = r"""
+import json, sys
+pid = int(sys.argv[1]); port = sys.argv[2]; corpus = sys.argv[3]
+out_path = sys.argv[4]
+from map_oxidize_tpu.config import JobConfig
+from map_oxidize_tpu.parallel.distributed import (
+    init_distributed, run_distributed_wordcount)
+init_distributed(f"127.0.0.1:{port}", num_processes=2, process_id=pid)
+cfg = JobConfig(input_path=corpus, output_path="", chunk_bytes=4096,
+                batch_size=1 << 12, key_capacity=1 << 12, top_k=5,
+                metrics=False)
+counts, top = run_distributed_wordcount(cfg, "wordcount")
+with open(out_path, "w") as f:
+    json.dump({"counts": {str(k): v for k, v in counts.items()},
+               "top": top}, f, sort_keys=True)
+print("child", pid, "ok", len(counts))
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_wordcount_matches_oracle(tmp_path):
+    rng = np.random.default_rng(11)
+    words = [b"Alpha", b"beta,", b"Gamma.", b"delta", b"eps;", b"zeta"]
+    corpus = tmp_path / "c.txt"
+    with open(corpus, "wb") as f:
+        for _ in range(3000):
+            f.write(b" ".join(words[int(i)]
+                              for i in rng.integers(0, 6, 6)) + b"\n")
+
+    env = dict(os.environ)
+    for k in ("PALLAS_AXON_POOL_IPS", "PJRT_LIBRARY_PATH",
+              "TPU_LIBRARY_PATH", "PJRT_DEVICE", "TPU_ACCELERATOR_TYPE",
+              "TPU_TOPOLOGY", "TPU_WORKER_HOSTNAMES", "_MOXT_DRYRUN_CHILD"):
+        env.pop(k, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    port = _free_port()
+    outs = [str(tmp_path / f"out_{i}.json") for i in range(2)]
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _CHILD, str(i), str(port), str(corpus),
+         outs[i]],
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True) for i in range(2)]
+    logs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=420)
+        logs.append(out)
+    for i, p in enumerate(procs):
+        assert p.returncode == 0, f"process {i} failed:\n{logs[i]}"
+
+    # oracle: hash-keyed reference-semantics counts
+    from map_oxidize_tpu.ops.hashing import moxt64_bytes
+    from map_oxidize_tpu.workloads.reference_model import wordcount_model
+
+    with open(corpus, "rb") as f:
+        model = wordcount_model([f.read()])
+    want = {moxt64_bytes(w): c for w, c in model.items()}
+
+    results = []
+    for path in outs:
+        with open(path) as f:
+            d = json.load(f)
+        results.append(d)
+    # both processes see the SAME replicated result
+    assert results[0] == results[1]
+    got = {int(k): v for k, v in results[0]["counts"].items()}
+    assert got == want
+    # device top-k matches the oracle's count-descending head
+    want_top = sorted(want.values(), reverse=True)[:5]
+    assert [c for _, c in results[0]["top"]] == want_top
