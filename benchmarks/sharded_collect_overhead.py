@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Characterize the sharded-collect exchange: bucket_cap cost and the
+receive buffer's residency (the round-2 advisor's S x padded-block
+retention, fixed in round 3 by compact-on-append).
+
+Run on the virtual 8-device CPU mesh:
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python benchmarks/sharded_collect_overhead.py
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from map_oxidize_tpu.api import MapOutput  # noqa: E402
+from map_oxidize_tpu.config import JobConfig  # noqa: E402
+from map_oxidize_tpu.ops.hashing import HashDictionary, split_u64  # noqa: E402
+from map_oxidize_tpu.parallel.collect import ShardedCollectEngine  # noqa: E402
+
+
+def run(S: int, cap_label: str, cap: int, n_rows: int, batch: int,
+        n_terms: int, repeats: int = 3):
+    rng = np.random.default_rng(7)
+    terms = rng.integers(0, 2**62, size=n_terms, dtype=np.uint64)
+    keys = terms[rng.integers(0, n_terms, size=n_rows)]
+    docs = np.sort(rng.integers(0, 2**40, size=n_rows).astype(np.uint64))
+    hi, lo = split_u64(keys)
+    vals = np.empty((n_rows, 2), np.uint32)
+    vals[:, 0] = (docs >> np.uint64(32)).astype(np.uint32)
+    vals[:, 1] = (docs & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+
+    best = None
+    resident = 0
+    for _ in range(repeats):
+        eng = ShardedCollectEngine(
+            JobConfig(batch_size=batch, num_shards=S, backend="cpu"),
+            bucket_cap=cap)
+        t0 = time.perf_counter()
+        for start in range(0, n_rows, batch):
+            stop = min(start + batch, n_rows)
+            eng.feed(MapOutput(hi=hi[start:stop], lo=lo[start:stop],
+                               values=vals[start:stop],
+                               dictionary=HashDictionary()))
+        k, d = eng.finalize()
+        dt = time.perf_counter() - t0
+        assert k.shape[0] == n_rows
+        resident = eng.S * eng.R
+        best = dt if best is None else min(best, dt)
+    return best, resident
+
+
+def main():
+    n_rows = 1 << 19
+    batch = 1 << 15
+    n_terms = 4096
+    print(f"rows={n_rows}, batch={batch}, terms={n_terms} "
+          f"(uniform hash -> flat buckets)")
+    print(f"{'S':>2} {'bucket_cap':>12} {'secs':>7} {'rows/s':>9} "
+          f"{'resident rows':>13} {'resident/fed':>12}")
+    for S in (2, 4, 8):
+        bps = batch // S
+        for label, cap in (("safe (bps)", bps),
+                           ("2x expected", max(1, 2 * batch // S // S)),
+                           ("1.2x expected", max(1, batch * 6 // (5 * S * S)))):
+            try:
+                secs, resident = run(S, label, cap, n_rows, batch, n_terms)
+                print(f"{S:>2} {label:>12} {secs:7.2f} {n_rows/secs:9.0f} "
+                      f"{resident:>13} {resident/n_rows:12.2f}")
+            except RuntimeError as e:
+                print(f"{S:>2} {label:>12}  OVERFLOW ({e})")
+
+
+if __name__ == "__main__":
+    main()

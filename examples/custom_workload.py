@@ -79,7 +79,38 @@ def run(path: str, num_shards: int = 1):
     return result.counts
 
 
+def run_device_topk(path: str, k: int = 5, num_shards: int = 1):
+    """The engine-level DEVICE top-k on a min monoid: the k warmest city
+    minima, selected by ``lax.top_k`` on-chip (padding masked to the dtype
+    floor — a min identity is the dtype MAX and is never a winner).
+    Demonstrates that user monoids get the same device report path as the
+    built-in sum workloads."""
+    from map_oxidize_tpu.io.splitter import iter_chunks
+    from map_oxidize_tpu.ops.hashing import join_u64
+    from map_oxidize_tpu.runtime.driver import make_engine
+
+    cfg = JobConfig(input_path=path, output_path="", num_shards=num_shards,
+                    metrics=False)
+    mapper = MinTempMapper()
+    engine = make_engine(cfg, MinReducer())
+    dictionary = HashDictionary()
+    for chunk in iter_chunks(path, cfg.chunk_bytes):
+        out = mapper.map_chunk(bytes(chunk))
+        dictionary.update(out.dictionary)
+        engine.feed(out)
+    t_hi, t_lo, t_vals, n = engine.top_k(k)
+    m = min(k, n)  # rows past the live count are SENTINEL padding
+    lookup = dictionary.lookup
+    return [(lookup(int(h)), int(v))
+            for h, v in zip(join_u64(t_hi[:m], t_lo[:m]).tolist(),
+                            np.asarray(t_vals)[:m].tolist())], n
+
+
 if __name__ == "__main__":
     counts = run(sys.argv[1])
     for city, t in sorted(counts.items(), key=lambda kv: kv[1])[:10]:
+        print(f"{city.decode()}: {t}")
+    top, n = run_device_topk(sys.argv[1])
+    print(f"device top-{len(top)} warmest minima (of {n} cities):")
+    for city, t in top:
         print(f"{city.decode()}: {t}")

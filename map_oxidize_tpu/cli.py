@@ -142,10 +142,9 @@ def main(argv: list[str] | None = None) -> int:
             print("error: distributed mode supports wordcount/bigram",
                   file=sys.stderr)
             return 2
-        if config.output_path and config.output_path != "final_result.txt":
-            _log.warning("--output is not wired for distributed mode "
-                         "(key strings live in per-process dictionaries); "
-                         "no file will be written")
+        _log.info("distributed mode reports hash-keyed top-k only; no "
+                  "output file is written (key strings live in per-process "
+                  "dictionaries)")
         if config.checkpoint_dir:
             _log.warning("--checkpoint-dir is not wired for distributed "
                          "mode; running without")

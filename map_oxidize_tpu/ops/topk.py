@@ -16,14 +16,38 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from map_oxidize_tpu.ops.hashing import SENTINEL
+
+
+def _mask_floor(vals):
+    """The value no real row can beat downward: dtype minimum (or -inf)."""
+    if jnp.issubdtype(vals.dtype, jnp.floating):
+        return -jnp.inf
+    return jnp.iinfo(vals.dtype).min
+
+
+def mask_padding(hi, lo, vals):
+    """Sink padding rows (SENTINEL keys) to the dtype floor so they lose
+    ``lax.top_k`` under ANY monoid — a min-monoid's identity is the dtype
+    MAX, which would otherwise outrank every real key.  Real rows that
+    genuinely hold the floor value tie with padding; within one array
+    ``lax.top_k`` prefers the lowest index and live rows are compacted to
+    the front, so they win.  Across a gather of several shards index order
+    no longer encodes liveness — the sharded final stage therefore
+    re-selects with an explicit live-preferred lexsort
+    (parallel/shuffle._topk_step) instead of trusting indices."""
+    live = ~((hi == jnp.uint32(SENTINEL)) & (lo == jnp.uint32(SENTINEL)))
+    return jnp.where(live, vals, _mask_floor(vals))
+
 
 def top_k_pairs(hi, lo, counts, k: int):
-    """Top-``k`` rows by ``counts`` (descending).  Returns
-    ``(hi_k, lo_k, counts_k)``.  Padding rows carry identity counts (0 for
-    sum) so they lose to any real row with a positive count."""
+    """Top-``k`` rows by value (descending), any monoid: padding rows are
+    masked to the dtype floor, not assumed to carry a losing identity.
+    Returns ``(hi_k, lo_k, counts_k)``; when fewer than ``k`` live rows
+    exist, the tail rows carry SENTINEL keys (mask on the key planes)."""
     if counts.ndim != 1:
         raise ValueError("top_k_pairs expects scalar per-key counts")
-    top_vals, top_idx = lax.top_k(counts, k)
+    top_vals, top_idx = lax.top_k(mask_padding(hi, lo, counts), k)
     return jnp.take(hi, top_idx), jnp.take(lo, top_idx), top_vals
 
 

@@ -16,9 +16,14 @@ output.
 Skew note: a term's rows all route to one bucket (that is what grouping
 means), so the default ``bucket_cap`` is the fully-safe ``batch_per_shard``
 — a shard's whole local block may target one destination and nothing can
-overflow.  The cost is exchange padding (S·cap rows move per flush); pass a
-tighter ``bucket_cap`` when the term distribution is known to be flat, and
-the counted-overflow guard still aborts loudly rather than dropping rows.
+overflow.  The exchanged block is S·cap rows regardless of cap, but
+received rows are compacted on append (SENTINEL-last sort + cursor write),
+so residency stays <= 2x live rows at any cap; the measured
+characterization (benchmarks/RESULTS.md round 3) additionally shows the
+safe cap is no slower than tight caps on the test mesh, which is why it
+stays the default.  Pass a tighter ``bucket_cap`` on bandwidth-bound
+meshes; the counted-overflow guard still aborts loudly rather than
+dropping rows.
 """
 
 from __future__ import annotations
@@ -58,29 +63,68 @@ class ShardedCollectEngine:
         self.feed_batch = self.batch_per_shard * S
         # fully-safe default: one bucket can absorb a shard's whole block
         self.bucket_cap = bucket_cap if bucket_cap > 0 else self.batch_per_shard
+        #: rows one exchange hands each shard ([S source buckets] x cap)
+        self.block = S * self.bucket_cap
         self.max_rows = max_rows
         self.rows_fed = 0
         self._stage: list = []
         self._staged = 0
-        self._blocks: list = []        # [S, S*cap] device arrays (4 planes)
-        self._block_rows = 0
         self._overflows: list = []     # replicated device scalars, one/flush
         self._row_spec = NamedSharding(self.mesh, P(SHARD_AXIS))
 
-        spec = P(SHARD_AXIS)
+        # Per-shard COMPACTED receive buffer [S, R]: each flush's exchanged
+        # block is sorted (SENTINEL keys last), then written at the shard's
+        # fill cursor with dynamic_update_slice — so only live rows stay
+        # resident.  The previous design retained every [S, S*cap] padded
+        # block: with the safe default cap that is an S x resident blowup
+        # over the rows actually fed (round-2 advisor finding), and the
+        # max_rows guard never saw it because it counts rows_fed.
+        self._buf: tuple | None = None   # 4 planes [S, R]
+        self._cursor = None              # [S] int32, per-shard fill level
+        self.R = 0                       # per-shard buffer capacity
+        self._cursor_ub = 0              # host upper bound of max cursor
 
-        def _route(hi, lo, dhi, dlo):
+        spec = P(SHARD_AXIS)
+        row2 = P(SHARD_AXIS, None)
+
+        def _route_append(bh, bl, bdh, bdl, cur, hi, lo, dhi, dlo):
             vals = jnp.stack([dhi, dlo], axis=1)
             r_hi, r_lo, r_vals, ovf = _exchange(
                 hi, lo, vals, S, self.bucket_cap)
-            return (r_hi[None], r_lo[None], r_vals[:, 0][None],
-                    r_vals[:, 1][None], ovf)
+            # compact: 2-key sort moves SENTINEL rows (key = max) to the
+            # end; doc planes ride along
+            s_h, s_l, s_dh, s_dl = lax.sort(
+                (r_hi, r_lo, r_vals[:, 0], r_vals[:, 1]), num_keys=2)
+            live = jnp.sum(
+                ~((s_h == jnp.uint32(SENTINEL))
+                  & (s_l == jnp.uint32(SENTINEL)))).astype(jnp.int32)
+            c = cur[0]
+            # write the whole block at the cursor: rows past `live` are
+            # SENTINEL and the NEXT append's cursor (c + live) overwrites
+            # them; the host guarantees R >= cursor + block headroom
+            out = [lax.dynamic_update_slice(b[0], s, (c,))[None]
+                   for b, s in ((bh, s_h), (bl, s_l), (bdh, s_dh),
+                                (bdl, s_dl))]
+            return (*out, (c + live)[None], ovf)
 
-        self._route = jax.jit(jax.shard_map(
-            _route, mesh=self.mesh,
-            in_specs=(spec, spec, spec, spec),
-            out_specs=(P(SHARD_AXIS, None),) * 4 + (P(),),
-        ))
+        self._route_append = jax.jit(jax.shard_map(
+            _route_append, mesh=self.mesh,
+            in_specs=(row2,) * 4 + (spec,) * 5,
+            out_specs=(row2,) * 4 + (spec, P()),
+        ), donate_argnums=(0, 1, 2, 3, 4))
+
+        def _grow(bh, bl, bdh, bdl, pad):
+            filler = jnp.full((1, pad), jnp.uint32(SENTINEL))
+            return tuple(jnp.concatenate([b, filler], axis=1)
+                         for b in (bh, bl, bdh, bdl))
+
+        def _make_grow(pad):
+            return jax.jit(jax.shard_map(
+                partial(_grow, pad=pad), mesh=self.mesh,
+                in_specs=(row2,) * 4, out_specs=(row2,) * 4),
+                donate_argnums=(0, 1, 2, 3))
+
+        self._make_grow = _make_grow
 
         def _sort(hi, lo, dhi, dlo):
             s = lax.sort((hi[0], lo[0], dhi[0], dlo[0]), num_keys=4)
@@ -88,9 +132,36 @@ class ShardedCollectEngine:
 
         self._sort = jax.jit(jax.shard_map(
             _sort, mesh=self.mesh,
-            in_specs=(P(SHARD_AXIS, None),) * 4,
-            out_specs=(P(SHARD_AXIS, None),) * 4,
+            in_specs=(row2,) * 4,
+            out_specs=(row2,) * 4,
         ))
+
+    def _ensure_room(self) -> None:
+        """Grow the receive buffer so one more exchanged block always fits
+        below R (dynamic_update_slice would clamp-and-overwrite otherwise).
+        ``_cursor_ub`` over-approximates without device syncs; the exact
+        cursor is fetched only when a growth looks necessary."""
+        needed = self._cursor_ub + self.block
+        if self._buf is None:
+            self.R = max(next_pow2(needed), 1 << 12)
+            filler = np.full((self.S, self.R), SENTINEL, np.uint32)
+            self._buf = tuple(
+                jax.device_put(filler, NamedSharding(self.mesh,
+                                                     P(SHARD_AXIS, None)))
+                for _ in range(4))
+            self._cursor = jax.device_put(
+                np.zeros(self.S, np.int32), self._row_spec)
+            return
+        if needed <= self.R:
+            return
+        # refresh the bound from the device before paying a growth
+        self._cursor_ub = int(np.max(np.asarray(self._cursor)))
+        needed = self._cursor_ub + self.block
+        if needed <= self.R:
+            return
+        new_R = next_pow2(needed)
+        self._buf = self._make_grow(new_R - self.R)(*self._buf)
+        self.R = new_R
 
     def feed(self, out: MapOutput) -> None:
         n = len(out)
@@ -130,11 +201,15 @@ class ShardedCollectEngine:
             p_lo[:n] = lo[start:stop]
             p_dhi[:n] = vals[start:stop, 0]
             p_dlo[:n] = vals[start:stop, 1]
+            self._ensure_room()
             batch = tuple(jax.device_put(x, self._row_spec)
                           for x in (p_hi, p_lo, p_dhi, p_dlo))
-            *planes, ovf = self._route(*batch)
-            self._blocks.append(planes)       # each [S, S*cap]
-            self._block_rows += planes[0].shape[1]
+            *state, ovf = self._route_append(*self._buf, self._cursor,
+                                             *batch)
+            self._buf = tuple(state[:4])
+            self._cursor = state[4]
+            # worst case every live row landed on one shard
+            self._cursor_ub += min(n, self.block)
             self._overflows.append(ovf)
 
     def finalize(self):
@@ -149,12 +224,10 @@ class ShardedCollectEngine:
                     f"{dropped} rows dropped in the collect exchange: a "
                     "bucket overflowed bucket_cap; use the default safe cap "
                     "or raise it")
-        if not self._blocks:
+        if self._buf is None:
             return np.empty(0, np.uint64), np.empty(0, np.int64)
-        planes = [jnp.concatenate([blk[i] for blk in self._blocks], axis=1)
-                  for i in range(4)]
         s_hi, s_lo, s_dhi, s_dlo = [np.asarray(x)
-                                    for x in self._sort(*planes)]
+                                    for x in self._sort(*self._buf)]
         keys_parts, docs_parts = [], []
         sent = np.uint32(SENTINEL)
         for s in range(self.S):

@@ -68,12 +68,6 @@ def init_distributed(coordinator: str, num_processes: int, process_id: int,
               len(jax.local_devices()))
 
 
-def _replicated(mesh):
-    from jax.sharding import NamedSharding, PartitionSpec
-
-    return NamedSharding(mesh, PartitionSpec())
-
-
 class DistributedReduceEngine:
     """Multi-process wrapper around :class:`ShardedReduceEngine`.
 
@@ -88,7 +82,7 @@ class DistributedReduceEngine:
         import jax
 
         from map_oxidize_tpu.parallel.engine import ShardedReduceEngine
-        from map_oxidize_tpu.parallel.mesh import make_mesh
+        from map_oxidize_tpu.parallel.mesh import make_mesh, replicated
 
         self.mesh = mesh if mesh is not None else make_mesh(
             config.num_shards, config.backend)
@@ -99,7 +93,7 @@ class DistributedReduceEngine:
         self._eng._read_live = self._read_live
         self._eng._check_health = self._check_health
         self._rep = jax.jit(lambda x: x,
-                            out_shardings=_replicated(self.mesh))
+                            out_shardings=replicated(self.mesh))
         self.n_proc = jax.process_count()
         self.proc = jax.process_index()
         # rows this process contributes to each global merge

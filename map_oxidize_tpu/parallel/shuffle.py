@@ -144,17 +144,28 @@ def _topk_step(acc_hi, acc_lo, acc_vals, k_local: int, k_final: int):
     most capacity distinct keys, so when k exceeds capacity its whole
     accumulator is its candidate set and nothing can be missed.  The final
     top-k runs over all ``S * k_local`` gathered candidates and returns
-    ``k_final = min(k, S * k_local)`` rows.  Only the 'sum' monoid is
-    eligible (padding carries 0, losing to any positive count) — mirrors the
-    single-device engine's restriction."""
-    v, i = lax.top_k(acc_vals, k_local)
+    ``k_final = min(k, S * k_local)`` rows.  Any monoid is eligible:
+    padding rows are masked to the dtype floor (ops.topk.mask_padding)
+    rather than trusted to carry a losing identity — a min identity is the
+    dtype MAX and would otherwise win."""
+    from map_oxidize_tpu.ops.topk import mask_padding
+
+    v, i = lax.top_k(mask_padding(acc_hi, acc_lo, acc_vals), k_local)
     h = jnp.take(acc_hi, i)
     l = jnp.take(acc_lo, i)
     gh = lax.all_gather(h, SHARD_AXIS, tiled=True)   # [S*k_local]
     gl = lax.all_gather(l, SHARD_AXIS, tiled=True)
     gv = lax.all_gather(v, SHARD_AXIS, tiled=True)
-    fv, fi = lax.top_k(gv, k_final)
-    return jnp.take(gh, fi), jnp.take(gl, fi), fv
+    # final select: value-descending with LIVE rows preferred on ties.  A
+    # plain top_k would prefer the lowest gathered index, and a lower
+    # shard's floor-masked padding precedes a higher shard's real
+    # floor-valued key in the gather — lexsort (value asc, live last) then
+    # take the tail reversed, so among equal values live rows win.
+    live = (~((gh == jnp.uint32(SENTINEL))
+              & (gl == jnp.uint32(SENTINEL)))).astype(jnp.int32)
+    order = jnp.lexsort((live, gv))
+    sel = order[-k_final:][::-1]
+    return jnp.take(gh, sel), jnp.take(gl, sel), jnp.take(gv, sel)
 
 
 def build_sharded_ops(mesh, combine: str = "sum", bucket_cap: int = 0,

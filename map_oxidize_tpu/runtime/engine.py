@@ -271,14 +271,12 @@ class StreamingEngineBase(abc.ABC):
         """Device top-k over the accumulator -> (hi_k, lo_k, vals_k)."""
 
     def top_k(self, k: int):
-        """Device top-k over the current accumulator -> numpy arrays plus the
-        distinct-key count.
-
-        Only valid for the 'sum' monoid: padding rows carry the combine
-        identity, which for min/max would outrank real keys in top_k.
-        """
-        if self.combine != "sum":
-            raise ValueError("device top_k is only defined for combine='sum'")
+        """Device top-k (value-descending) over the current accumulator ->
+        numpy arrays plus the distinct-key count.  Valid for ANY monoid:
+        padding rows are masked to the dtype floor on device
+        (ops.topk.mask_padding), so a min-monoid's dtype-MAX identity
+        cannot outrank real keys.  Rows past the live count carry SENTINEL
+        keys — mask on keys, not values."""
         if self.value_shape != ():
             raise ValueError("top_k requires scalar values")
         *_, n = self.finalize()

@@ -33,3 +33,83 @@ def test_min_temperature_by_city(tmp_path, rng, num_shards):
     truth = _write_readings(path, rng)
     got = run(str(path), num_shards=num_shards)
     assert got == truth
+
+
+@pytest.mark.parametrize("num_shards", [1, 8])
+def test_device_top_k_on_min_monoid(tmp_path, rng, num_shards):
+    """The DEVICE top-k path must work for a non-sum monoid (round-2 weak
+    #8): padding rows carry the min identity (dtype MAX) and must be masked,
+    not trusted to lose."""
+    from custom_workload import run_device_topk
+
+    path = tmp_path / "readings.txt"
+    truth = _write_readings(path, rng)
+    top, n = run_device_topk(str(path), k=3, num_shards=num_shards)
+    assert n == len(truth)
+    want = sorted(truth.values(), reverse=True)[:3]
+    assert [v for _, v in top] == want
+    for city, v in top:
+        assert truth[city] == v
+
+
+@pytest.mark.parametrize("num_shards", [1, 8])
+def test_device_top_k_min_monoid_k_exceeds_live(tmp_path, num_shards):
+    """k > live keys: the tail must be SENTINEL-keyed padding, never a
+    padding row promoted above a real key."""
+    from map_oxidize_tpu.api import MapOutput, MinReducer
+    from map_oxidize_tpu.config import JobConfig
+    from map_oxidize_tpu.ops.hashing import (
+        SENTINEL64,
+        HashDictionary,
+        join_u64,
+        split_u64,
+    )
+    from map_oxidize_tpu.runtime.driver import make_engine
+
+    cfg = JobConfig(num_shards=num_shards, backend="cpu", metrics=False)
+    engine = make_engine(cfg, MinReducer())
+    keys = np.array([11, 22, 33], np.uint64)
+    vals = np.array([-5, 7, -9], np.int32)
+    hi, lo = split_u64(keys)
+    engine.feed(MapOutput(hi=hi, lo=lo, values=vals,
+                          dictionary=HashDictionary()))
+    t_hi, t_lo, t_vals, n = engine.top_k(10)
+    assert n == 3
+    k64 = join_u64(t_hi, t_lo)
+    live = k64 != np.uint64(SENTINEL64)
+    got = dict(zip(k64[live].tolist(), np.asarray(t_vals)[live].tolist()))
+    assert got == {22: 7, 11: -5, 33: -9}
+    # the three live rows outrank every padding row
+    assert list(np.nonzero(live)[0]) == [0, 1, 2]
+
+
+def test_sharded_top_k_floor_value_beats_cross_shard_padding():
+    """A real key whose reduced value IS the dtype floor must not lose to
+    another shard's floor-masked padding that precedes it in the gather
+    (the final stage re-selects live-preferred, not index-preferred)."""
+    from map_oxidize_tpu.api import MapOutput, MinReducer
+    from map_oxidize_tpu.config import JobConfig
+    from map_oxidize_tpu.ops.hashing import (
+        SENTINEL64,
+        HashDictionary,
+        join_u64,
+        split_u64,
+    )
+    from map_oxidize_tpu.runtime.driver import make_engine
+
+    cfg = JobConfig(num_shards=8, backend="cpu", metrics=False)
+    engine = make_engine(cfg, MinReducer())
+    keys = np.array([777], np.uint64)   # one real key, whichever shard owns it
+    vals = np.array([np.iinfo(np.int32).min], np.int32)
+    hi, lo = split_u64(keys)
+    engine.feed(MapOutput(hi=hi, lo=lo, values=vals,
+                          dictionary=HashDictionary()))
+    t_hi, t_lo, t_vals, n = engine.top_k(8)
+    assert n == 1
+    k64 = join_u64(t_hi, t_lo)
+    live = k64 != np.uint64(SENTINEL64)
+    assert int(np.sum(live)) == 1
+    assert k64[live][0] == 777
+    assert np.asarray(t_vals)[live][0] == np.iinfo(np.int32).min
+    # and the live row is ranked first
+    assert bool(live[0])
