@@ -135,6 +135,9 @@ def _load_lib():
         lib.moxt_resolve_read.restype = None
         lib.moxt_resolve_read.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                                           ctypes.c_void_p, ctypes.c_void_p]
+        lib.moxt_sort_kd.restype = ctypes.c_int32
+        lib.moxt_sort_kd.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                     ctypes.c_int64]
         _lib = lib
         return _lib
 
@@ -495,6 +498,40 @@ class NativeStream:
         """Novel (hash -> bytes) entries since the last drain."""
         with self._lock:
             return self._drain_dict_locked()
+
+
+def sort_kd_or_none(keys: np.ndarray, docs: np.ndarray | None):
+    """In-place stable ascending radix sort of ``keys`` (uint64) with
+    ``docs`` (int64) riding along; GIL released.  Returns True on success,
+    False when the native library is unavailable (caller falls back to
+    numpy).  Measured ~4x numpy's stable u64 sort at 30M rows."""
+    try:
+        lib = _load_lib()
+    except Exception:
+        return False
+    # in-place on raw pointers: refuse anything that is not exactly a
+    # writable, contiguous (u64, i64) pair — a contiguity copy would sort
+    # the copy, a wrong dtype would sort bitwise-wrong, and a read-only
+    # buffer would be mutated behind numpy's back.  Declining returns
+    # False so the caller's numpy fallback runs.
+    def _ok(a, dt):
+        return (a.dtype == np.dtype(dt) and a.flags.c_contiguous
+                and a.flags.writeable)
+
+    if not _ok(keys, np.uint64) or (docs is not None
+                                    and not _ok(docs, np.int64)):
+        return False
+    rc = lib.moxt_sort_kd(
+        keys.ctypes.data,
+        docs.ctypes.data if docs is not None else None,
+        keys.shape[0])
+    if rc:
+        # native scratch allocation failed (it needs ~32B/row); the numpy
+        # path needs less and may still succeed — fall back, don't abort
+        _log.warning("native radix sort could not allocate scratch; "
+                     "falling back to numpy")
+        return False
+    return True
 
 
 class StreamPool:

@@ -1322,6 +1322,112 @@ int64_t moxt_resolve_range(MoxtState* st, MoxtFile* f, int64_t off,
   return len;
 }
 
+// ---------------------------------------------------------------------------
+// Host radix sort for the collect paths.  numpy's stable u64 sort measures
+// ~4 s on 30M keys (one pass of the inverted-index finalize); an LSD radix
+// with 11-bit digits and a fused histogram pass does the same work in a
+// handful of streaming passes.  Stability is inherent to LSD scatter, which
+// the index relies on (doc order per term is feed order).
+// ---------------------------------------------------------------------------
+
+static const int kRadixBits = 11;
+static const int64_t kRadixSize = 1 << kRadixBits;   // 2048 buckets
+static const int kRadixPasses = (64 + kRadixBits - 1) / kRadixBits;  // 6
+
+// Sort keys ascending, docs riding along (docs may be null).  Returns 0,
+// or -1 on allocation failure.  In-place on the caller's arrays.
+int32_t moxt_sort_kd(uint64_t* keys, int64_t* docs, int64_t n) {
+  if (n <= 1) return 0;
+  int64_t* hist =
+      static_cast<int64_t*>(calloc(kRadixPasses * kRadixSize, 8));
+  if (!hist) return -1;
+  // one read pass builds every pass's histogram
+  for (int64_t i = 0; i < n; i++) {
+    uint64_t k = keys[i];
+    for (int p = 0; p < kRadixPasses; p++)
+      hist[p * kRadixSize + ((k >> (p * kRadixBits)) & (kRadixSize - 1))]++;
+  }
+  // prefix-sum each pass's histogram, skipping constant-digit passes
+  bool skip[kRadixPasses];
+  for (int p = 0; p < kRadixPasses; p++) {
+    int64_t* h = hist + p * kRadixSize;
+    int64_t nonzero = 0;
+    for (int64_t b = 0; b < kRadixSize && nonzero <= 1; b++)
+      if (h[b]) nonzero++;
+    skip[p] = nonzero <= 1;
+    if (skip[p]) continue;
+    int64_t sum = 0;
+    for (int64_t b = 0; b < kRadixSize; b++) {
+      int64_t c = h[b];
+      h[b] = sum;
+      sum += c;
+    }
+  }
+  if (docs) {
+    // interleave (key, doc) into 16-byte records so each scatter is ONE
+    // contiguous 16B write — two separate scatter streams double the
+    // random-write cache misses
+    struct KD {
+      uint64_t k;
+      int64_t d;
+    };
+    KD* a = static_cast<KD*>(malloc(n * sizeof(KD)));
+    KD* b = static_cast<KD*>(malloc(n * sizeof(KD)));
+    if (!a || !b) {
+      free(a);
+      free(b);
+      free(hist);
+      return -1;
+    }
+    for (int64_t i = 0; i < n; i++) a[i] = KD{keys[i], docs[i]};
+    KD* src = a;
+    KD* dst = b;
+    for (int p = 0; p < kRadixPasses; p++) {
+      if (skip[p]) continue;
+      int64_t* h = hist + p * kRadixSize;
+      const int shift = p * kRadixBits;
+      for (int64_t i = 0; i < n; i++)
+        dst[h[(src[i].k >> shift) & (kRadixSize - 1)]++] = src[i];
+      KD* sw = src;
+      src = dst;
+      dst = sw;
+    }
+    for (int64_t i = 0; i < n; i++) {
+      keys[i] = src[i].k;
+      docs[i] = src[i].d;
+    }
+    free(a);
+    free(b);
+    free(hist);
+    return 0;
+  }
+  uint64_t* tk = static_cast<uint64_t*>(malloc(n * 8));
+  if (!tk) {
+    free(hist);
+    return -1;
+  }
+  uint64_t* src_k = keys;
+  uint64_t* dst_k = tk;
+  for (int p = 0; p < kRadixPasses; p++) {
+    if (skip[p]) continue;
+    int64_t* h = hist + p * kRadixSize;
+    const int shift = p * kRadixBits;
+    for (int64_t i = 0; i < n; i++) {
+      int64_t pos = h[(src_k[i] >> shift) & (kRadixSize - 1)]++;
+      dst_k[pos] = src_k[i];
+    }
+    uint64_t* sw = src_k;
+    src_k = dst_k;
+    dst_k = sw;
+  }
+  if (src_k != keys) {
+    memcpy(keys, src_k, n * 8);
+  }
+  free(tk);
+  free(hist);
+  return 0;
+}
+
 // Found-entry drain: count + total bytes, then parallel columns.
 int64_t moxt_resolve_found(MoxtState* st, int64_t* nbytes) {
   if (nbytes) *nbytes = st->res_arena.size;

@@ -134,11 +134,16 @@ class CollectEngine:
             # STABLE sort by key alone: rows arrive in ascending doc order
             # per term by construction (chunks stream in file order; within
             # a chunk the mapper scans documents in line order), so
-            # stability alone yields (key, doc)-sorted rows — half the
-            # lexsort's cost, and integer-stable sort is radix in numpy.
-            # The parity suites (vs the independent oracle) pin this
-            # invariant; a mapper that emitted docs out of order would fail
-            # them.
+            # stability alone yields (key, doc)-sorted rows.  The native
+            # LSD radix (docs riding the scatter) measures ~4x numpy's
+            # stable argsort at 30M rows; numpy remains the fallback.
+            # The parity suites (vs the independent oracle) pin the
+            # ascending-doc invariant; a mapper that emitted docs out of
+            # order would fail them.
+            from map_oxidize_tpu.native.build import sort_kd_or_none
+
+            if self.config.use_native and sort_kd_or_none(keys, docs):
+                return keys, docs
             order = np.argsort(keys, kind="stable")
             return keys[order], docs[order]
         self.flush()

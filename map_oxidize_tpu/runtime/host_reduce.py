@@ -94,6 +94,12 @@ class HostCollectReduceEngine:
     def flush(self) -> None:  # feed is already host-resident
         pass
 
+    @staticmethod
+    def _segment_bounds(keys_sorted: np.ndarray) -> np.ndarray:
+        """Start index of each equal-key run in a sorted key array."""
+        return np.flatnonzero(np.concatenate(
+            [[True], keys_sorted[1:] != keys_sorted[:-1]]))
+
     def _reduce(self) -> tuple:
         if self._reduced is None:
             if not self._keys:
@@ -105,9 +111,19 @@ class HostCollectReduceEngine:
                 self._keys = self._vals = None  # free the blocks
                 if self.combine == "sum" and bool(np.all(vals == 1)):
                     # hash-only count path: every row weighs 1, so counts
-                    # are segment lengths — np.unique's fused sort+counts
-                    # skips the argsort permutation and two 8B/row gathers
-                    # (the checking pass is ~1% of the sort it saves)
+                    # are segment lengths — sort the keys alone and diff
+                    # the boundaries.  The native radix sort beats both
+                    # np.unique and np.sort at these sizes; numpy remains
+                    # the fallback.
+                    from map_oxidize_tpu.native.build import sort_kd_or_none
+
+                    if self.config.use_native and sort_kd_or_none(keys, None):
+                        bounds = self._segment_bounds(keys)
+                        counts = np.diff(np.append(bounds, keys.shape[0]))
+                        self._reduced = (
+                            keys[bounds],
+                            counts.astype(self.value_dtype, copy=False))
+                        return self._reduced
                     uniq, counts = np.unique(keys, return_counts=True)
                     self._reduced = (uniq,
                                      counts.astype(self.value_dtype,
@@ -116,8 +132,7 @@ class HostCollectReduceEngine:
                 order = np.argsort(keys, kind="stable")
                 keys = keys[order]
                 vals = vals[order]
-                bounds = np.flatnonzero(
-                    np.concatenate([[True], keys[1:] != keys[:-1]]))
+                bounds = self._segment_bounds(keys)
                 red = _UFUNC[self.combine].reduceat(
                     vals.astype(np.int64 if self.combine == "sum"
                                 else self.value_dtype), bounds)
