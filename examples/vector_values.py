@@ -1,0 +1,105 @@
+"""A vector-valued user workload: MEAN temperature by city.
+
+The min-temperature example (`custom_workload.py`) shows a scalar monoid;
+this one shows the other half of the Reducer surface: **vector values**.
+"Mean" is not a monoid, but (sum, count) is — each mapped row carries the
+value ``[temp_sum, n]`` and the engine's vector segment-sum folds both
+components at once (the same machinery k-means uses for its
+``[Σx, n]`` centroid rows).  The mean falls out at readback.
+
+    map:    city,temp line -> (hash(city), [temp, 1])
+    reduce: component-wise sum over value_shape=(2,)
+    report: sums[:, 0] / sums[:, 1]
+
+Run it:
+
+    python examples/vector_values.py /path/to/readings.txt
+
+Like every workload, it runs unchanged on the single-chip engine or the
+sharded mesh engine (``num_shards``).
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+from map_oxidize_tpu.api import Mapper, MapOutput, SumReducer
+from map_oxidize_tpu.config import JobConfig
+from map_oxidize_tpu.io.splitter import iter_chunks
+from map_oxidize_tpu.ops.hashing import (
+    SENTINEL,
+    HashDictionary,
+    join_u64,
+    moxt64_bytes,
+    split_u64,
+)
+from map_oxidize_tpu.runtime.driver import make_engine
+
+
+class MeanTempMapper(Mapper):
+    """``city,temp`` lines -> one (city_hash, [temp_sum, count]) row per
+    city seen in the chunk (in-chunk combining, like the built-ins)."""
+
+    value_shape = (2,)
+    value_dtype = np.float32
+    keys_have_dictionary = True
+    conserves_counts = False  # values are measurements, not counts
+
+    def map_chunk(self, chunk) -> MapOutput:
+        if not isinstance(chunk, bytes):
+            chunk = bytes(chunk)
+        sums: dict[bytes, float] = {}
+        counts: dict[bytes, int] = {}
+        n = 0
+        for line in chunk.split(b"\n"):
+            city, _, temp = line.partition(b",")
+            try:
+                t = float(temp)
+            except ValueError:
+                continue  # malformed line: skipped, like main.rs:160
+            n += 1
+            sums[city] = sums.get(city, 0.0) + t
+            counts[city] = counts.get(city, 0) + 1
+        d = HashDictionary()
+        hashes = np.empty(len(sums), np.uint64)
+        values = np.empty((len(sums), 2), np.float32)
+        for i, (city, s) in enumerate(sums.items()):
+            h = moxt64_bytes(city)
+            d.add(h, city)
+            hashes[i] = h
+            values[i, 0] = s
+            values[i, 1] = counts[city]
+        hi, lo = split_u64(hashes)
+        return MapOutput(hi=hi, lo=lo, values=values, dictionary=d,
+                         records_in=n)
+
+
+def run(path: str, num_shards: int = 1) -> dict[bytes, float]:
+    cfg = JobConfig(input_path=path, output_path="", num_shards=num_shards,
+                    metrics=False)
+    mapper = MeanTempMapper()
+    engine = make_engine(cfg, SumReducer(), value_shape=(2,),
+                         value_dtype=np.float32)
+    dictionary = HashDictionary()
+    for chunk in iter_chunks(path, cfg.chunk_bytes):
+        out = mapper.map_chunk(chunk)
+        dictionary.update(out.dictionary)
+        engine.hint_total_keys(dictionary.upper_bound())
+        engine.feed(out)
+    hi, lo, vals, n = engine.finalize()
+    hi, lo, vals = np.asarray(hi), np.asarray(lo), np.asarray(vals)
+    live = ~((hi == np.uint32(SENTINEL)) & (lo == np.uint32(SENTINEL)))
+    k64 = join_u64(hi[live], lo[live])
+    v = vals[live]
+    assert k64.shape[0] == n
+    lookup = dictionary.lookup
+    return {lookup(int(h)): float(s) / c
+            for h, (s, c) in zip(k64.tolist(), v.tolist())}
+
+
+if __name__ == "__main__":
+    means = run(sys.argv[1])
+    for city, m in sorted(means.items()):
+        print(f"{city.decode()}: {m:.2f}")

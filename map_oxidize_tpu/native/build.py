@@ -515,11 +515,11 @@ def sort_kd_or_none(keys: np.ndarray, docs: np.ndarray | None):
     # buffer would be mutated behind numpy's back.  Declining returns
     # False so the caller's numpy fallback runs.
     def _ok(a, dt):
-        return (a.dtype == np.dtype(dt) and a.flags.c_contiguous
-                and a.flags.writeable)
+        return (a.dtype == np.dtype(dt) and a.ndim == 1
+                and a.flags.c_contiguous and a.flags.writeable)
 
-    if not _ok(keys, np.uint64) or (docs is not None
-                                    and not _ok(docs, np.int64)):
+    if not _ok(keys, np.uint64) or (docs is not None and not (
+            _ok(docs, np.int64) and docs.shape == keys.shape)):
         return False
     rc = lib.moxt_sort_kd(
         keys.ctypes.data,

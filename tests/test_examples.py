@@ -83,6 +83,29 @@ def test_device_top_k_min_monoid_k_exceeds_live(tmp_path, num_shards):
     assert list(np.nonzero(live)[0]) == [0, 1, 2]
 
 
+@pytest.mark.parametrize("num_shards", [1, 8])
+def test_mean_temperature_vector_values(tmp_path, rng, num_shards):
+    """Vector-valued user workload: mean via a (sum, count) value row —
+    the non-monoid-through-a-monoid pattern, on both engines."""
+    from vector_values import run
+
+    path = tmp_path / "readings.txt"
+    cities = [b"Oslo", b"Nairobi", b"Quito"]
+    sums: dict[bytes, float] = {}
+    counts: dict[bytes, int] = {}
+    with open(path, "wb") as f:
+        for _ in range(1500):
+            c = cities[int(rng.integers(0, len(cities)))]
+            t = int(rng.integers(-40, 45))
+            f.write(c + b"," + str(t).encode() + b"\n")
+            sums[c] = sums.get(c, 0.0) + t
+            counts[c] = counts.get(c, 0) + 1
+    got = run(str(path), num_shards=num_shards)
+    assert set(got) == set(sums)
+    for c in sums:
+        assert abs(got[c] - sums[c] / counts[c]) < 1e-3
+
+
 def test_sharded_top_k_floor_value_beats_cross_shard_padding():
     """A real key whose reduced value IS the dtype floor must not lose to
     another shard's floor-masked padding that precedes it in the gather
