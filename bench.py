@@ -286,8 +286,36 @@ def _bench_workloads(run_job, JobConfig) -> dict:
         pts = (c[rng.integers(0, 64, 400_000)]
                + rng.normal(0, 0.5, (400_000, 32))).astype(np.float32)
         np.save(pts_path, pts)
+
+    # CPU baseline: single-thread NumPy of the same semantics — the SAME
+    # vectorized formulation the host mapper uses (argmin-distance assign,
+    # bincount partial sums), not the per-cluster-mask oracle, so the ratio
+    # measures the framework against a competent host implementation.
+    from map_oxidize_tpu.workloads.kmeans import assign_points
+
+    def km_cpu_iter(p, c):
+        cid = assign_points(p, c)
+        k, d = c.shape
+        sums = np.empty((k, d), np.float32)
+        for j in range(d):
+            sums[:, j] = np.bincount(cid, weights=p[:, j], minlength=k)
+        counts = np.bincount(cid, minlength=k)
+        new = c.copy()
+        nz = counts > 0
+        new[nz] = sums[nz] / counts[nz, None]
+        return new
+
+    pts_all = np.asarray(np.load(pts_path, mmap_mode="r"), np.float32)
+    km_init = pts_all[:64].copy()
+    t0 = time.perf_counter()
+    km_base = km_init
+    for _ in range(2):
+        km_base = km_cpu_iter(pts_all, km_base)
+    km_base_rate = pts_all.shape[0] * 2 / (time.perf_counter() - t0)
+
     # streamed (2 iters) vs HBM-resident device variant (20 iters: points
     # transfer once, iterations are MXU matmuls that amortize it)
+    km_parity_checked = False
     for mapper, iters, name in (
         ("auto", 2, "kmeans_400k_d32_k64"),
         ("device", 20, "kmeans_device_400k_d32_k64_20iter"),
@@ -295,11 +323,18 @@ def _bench_workloads(run_job, JobConfig) -> dict:
         cfg = JobConfig(input_path=pts_path, output_path="", backend="auto",
                         metrics=True, kmeans_k=64, kmeans_iters=iters,
                         mapper=mapper)
-        run_job(cfg, "kmeans")  # warm
+        r = run_job(cfg, "kmeans")  # warm
+        if not km_parity_checked:  # 2-iter run == 2 baseline iterations
+            if not np.allclose(r.centroids, km_base, rtol=1e-3, atol=1e-3):
+                return {"error": "kmeans parity FAILED vs NumPy baseline"}
+            km_parity_checked = True
         r, secs = best_of(lambda: run_job(cfg, "kmeans"))
+        rate = r.metrics["records_in"] / secs
         out[name] = {
             "best_s": round(secs, 3),
-            "point_iters_per_sec": round(r.metrics["records_in"] / secs, 1),
+            "point_iters_per_sec": round(rate, 1),
+            "vs_baseline": round(rate / km_base_rate, 3),
+            "cpu_baseline_point_iters_per_sec": round(km_base_rate, 1),
             "iters": int(r.metrics["iters"]),
         }
     return out

@@ -66,6 +66,11 @@ def build_parser() -> argparse.ArgumentParser:
                    default="auto",
                    help="inverted-index pair sort placement (auto: host — "
                         "the measured winner on a remote-attached chip)")
+    p.add_argument("--rescan-full", action="store_true",
+                   help="hash-only mode: rescan the whole corpus when "
+                        "resolving winner strings (extends the collision "
+                        "byte-check to every occurrence) instead of "
+                        "stopping once all queried keys are found")
     p.add_argument("--kmeans-k", type=int, default=16,
                    help="k-means cluster count (init: first k points)")
     p.add_argument("--kmeans-iters", type=int, default=1,
@@ -112,6 +117,7 @@ def config_from_args(args: argparse.Namespace) -> JobConfig:
         checkpoint_dir=args.checkpoint_dir,
         keep_intermediates=args.keep_intermediates,
         trace_dir=args.trace_dir,
+        rescan_full=args.rescan_full,
         kmeans_k=args.kmeans_k,
         kmeans_iters=args.kmeans_iters,
     ).validate()

@@ -82,6 +82,11 @@ class JobConfig:
     dist_coordinator: str = ""
     dist_num_processes: int = 0
     dist_process_id: int = -1
+    #: hash-only rescan: scan the whole corpus when resolving winner
+    #: strings instead of stopping once every queried hash is found.  The
+    #: full scan extends the collision byte-check from the scanned prefix to
+    #: every occurrence in the corpus, at the cost of a corpus-length pass.
+    rescan_full: bool = False
     #: k-means: cluster count (init = first k points of the input)
     kmeans_k: int = 16
     #: k-means: iterations to run

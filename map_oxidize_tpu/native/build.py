@@ -132,6 +132,8 @@ def _load_lib():
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64]
         lib.moxt_resolve_found.restype = ctypes.c_int64
         lib.moxt_resolve_found.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+        lib.moxt_resolve_remaining.restype = ctypes.c_int64
+        lib.moxt_resolve_remaining.argtypes = [ctypes.c_void_p]
         lib.moxt_resolve_read.restype = None
         lib.moxt_resolve_read.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                                           ctypes.c_void_p, ctypes.c_void_p]
@@ -432,12 +434,21 @@ class NativeStream:
         finally:
             self._lib.moxt_file_close(f)
 
-    def resolve_file(self, path: str, chunk_bytes: int, hashes: np.ndarray):
+    def resolve_file(self, path: str, chunk_bytes: int, hashes: np.ndarray,
+                     early_stop: bool = True):
         """Recover key bytes for ``hashes`` by rescanning the corpus with
         the SAME chunk cuts the hash-only map used.  Returns
         ``(found_hashes u64, lens i32, blob bytes)``; a 64-bit collision
         involving any queried key raises (first occurrence's bytes are
-        compared against every later occurrence)."""
+        compared against every later occurrence in the scanned range).
+
+        ``early_stop`` ends the scan as soon as every queried hash has been
+        seen once — for top-k winners (by construction the most frequent
+        keys) that is typically within the first chunks, making the rescan
+        cost ~independent of corpus size.  The trade: the collision
+        byte-check then covers the scanned prefix, not the whole corpus;
+        pass ``early_stop=False`` (config ``rescan_full``) for the
+        full-corpus check."""
         hashes = np.ascontiguousarray(hashes, np.uint64)
         with self._lock:
             rc = self._lib.moxt_resolve_begin(
@@ -461,6 +472,10 @@ class NativeStream:
                         raise RuntimeError(
                             f"native resolve_range stalled at {off}")
                     off += consumed
+                    if (early_stop
+                            and self._lib.moxt_resolve_remaining(self._st)
+                            == 0):
+                        break
             finally:
                 self._lib.moxt_file_close(f)
             nbytes = ctypes.c_int64()
@@ -577,8 +592,9 @@ class StreamPool:
                          start_offset: int = 0):
         return self.get().iter_file_hashes(path, chunk_bytes, start_offset)
 
-    def resolve_file(self, path: str, chunk_bytes: int, hashes):
-        return self.get().resolve_file(path, chunk_bytes, hashes)
+    def resolve_file(self, path: str, chunk_bytes: int, hashes,
+                     early_stop: bool = True):
+        return self.get().resolve_file(path, chunk_bytes, hashes, early_stop)
 
     def close(self) -> None:
         with self._lock:

@@ -373,6 +373,7 @@ struct MoxtState {
   int64_t* q_ref = nullptr;
   uint32_t* q_len = nullptr;
   int64_t q_cap = 0, q_n = 0;
+  int64_t q_distinct = 0;       // distinct queried hashes (dup inputs merge)
   int64_t* found = nullptr;     // q-table slots in discovery order
   int64_t found_n = 0, found_cap = 0;
   Arena res_arena;
@@ -1257,6 +1258,7 @@ int32_t moxt_resolve_begin(MoxtState* st, const uint64_t* hashes, int64_t n) {
   if (!st->q_h || !st->q_ref || !st->q_len) return 2;
   // q_ref: -2 = empty slot, -1 = wanted/unseen, >=0 = found at arena offset
   for (int64_t i = 0; i < cap; i++) st->q_ref[i] = -2;
+  st->q_distinct = 0;
   for (int64_t i = 0; i < n; i++) {
     uint64_t h = hashes[i];
     int64_t j = h & (cap - 1);
@@ -1265,9 +1267,21 @@ int32_t moxt_resolve_begin(MoxtState* st, const uint64_t* hashes, int64_t n) {
       j = (j + 1) & (cap - 1);
     }
     st->q_h[j] = h;
-    if (st->q_ref[j] == -2) st->q_ref[j] = -1;
+    if (st->q_ref[j] == -2) {
+      st->q_ref[j] = -1;
+      st->q_distinct++;
+    }
   }
   return 0;
+}
+
+// Queried-but-unseen count.  When it hits zero the caller may stop scanning
+// early: every requested key's bytes are recorded.  The collision byte-check
+// then covers occurrences up to the stop point rather than the whole corpus
+// (the full-scan guarantee remains available by just not stopping).
+int64_t moxt_resolve_remaining(MoxtState* st) {
+  if (!st) return -1;
+  return st->q_distinct - st->found_n;
 }
 
 // Scan one chunk; record bytes for the first occurrence of each queried

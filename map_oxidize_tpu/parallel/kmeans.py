@@ -27,12 +27,17 @@ from map_oxidize_tpu.parallel.mesh import SHARD_AXIS, make_mesh
 
 
 def kmeans_fit_sharded(points, centroids, iters: int = 1, mesh=None,
-                       num_shards: int = 0, backend: str = "auto"):
+                       num_shards: int = 0, backend: str = "auto",
+                       on_iter=None):
     """Run ``iters`` k-means iterations with points sharded over the mesh.
 
     ``points``: host ``(n, d)`` float32 (rows pad to a multiple of the shard
     count with zero-weight rows, so padding never moves a centroid).
     Returns the final centroids as NumPy ``(k, d)``.
+
+    ``on_iter(i, centroids_np)`` (checkpoint hook): when given, the compiled
+    body runs one iteration per call — points stay sharded in HBM; only the
+    replicated ``(k, d)`` centroids and one psum per iteration move.
     """
     if mesh is None:
         mesh = make_mesh(num_shards, backend)
@@ -68,7 +73,7 @@ def kmeans_fit_sharded(points, centroids, iters: int = 1, mesh=None,
             return jnp.where(counts[:, None] > 0,
                              sums / jnp.maximum(counts[:, None], 1.0), c)
 
-        return lax.fori_loop(0, iters, step, c)
+        return lax.fori_loop(0, 1 if on_iter is not None else iters, step, c)
 
     fit_fn = jax.jit(jax.shard_map(
         fit, mesh=mesh,
@@ -80,4 +85,10 @@ def kmeans_fit_sharded(points, centroids, iters: int = 1, mesh=None,
     p_dev = jax.device_put(points, row)
     w_dev = jax.device_put(weights, row)
     c_dev = jax.device_put(centroids, rep)
-    return np.asarray(fit_fn(p_dev, w_dev, c_dev))
+    if on_iter is None:
+        return np.asarray(fit_fn(p_dev, w_dev, c_dev))
+    c = c_dev
+    for i in range(iters):
+        c = fit_fn(p_dev, w_dev, c)
+        on_iter(i + 1, np.asarray(c))
+    return np.asarray(c)

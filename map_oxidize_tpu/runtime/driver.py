@@ -267,7 +267,8 @@ def run_wordcount_job(config: JobConfig, mapper: Mapper, reducer: Reducer,
         mapper.hash_only = hash_only
     if hash_only:
         _, rb_chunk = plan_chunks(config.input_path, config.chunk_bytes)
-        dictionary = mapper.rescan_dictionary(config.input_path, rb_chunk)
+        dictionary = mapper.rescan_dictionary(
+            config.input_path, rb_chunk, early_stop=not config.rescan_full)
     else:
         dictionary = HashDictionary()
     records_in = 0
@@ -543,8 +544,6 @@ def run_kmeans_job(config: JobConfig, centroids: np.ndarray | None = None
     )
 
     config.validate()
-    if config.checkpoint_dir:
-        _log.warning("checkpointing is not wired for kmeans; running without")
     metrics = Metrics()
     pts = np.load(config.input_path, mmap_mode="r")
     if pts.ndim != 2:
@@ -559,16 +558,68 @@ def run_kmeans_job(config: JobConfig, centroids: np.ndarray | None = None
     centroids = np.asarray(centroids, np.float32)
     rows = max(1, config.chunk_bytes // (4 * d))
     device_mode = config.mapper == "device"
+    n_shards = effective_num_shards(config) if device_mode else 1
+
+    # --- checkpoint/resume: the iteration boundary is k-means's natural
+    # materialization barrier (centroids fully summarize progress), so the
+    # resumable artifact is one atomic snapshot of (centroids, iterations
+    # done), superseded each iteration.  kmeans_iters is deliberately NOT in
+    # the identity: a snapshot at iteration i resumes any same-job run
+    # asking for >= i iterations ("continue training"); k, mode, and shard
+    # count ARE identity (they change the float accumulation order).
+    store = None
+    start_iter = 0
+    if config.checkpoint_dir:
+        from map_oxidize_tpu.ops.hashing import HashDictionary
+        from map_oxidize_tpu.runtime.checkpoint import CheckpointStore
+
+        import hashlib
+
+        store = CheckpointStore(
+            config.checkpoint_dir,
+            CheckpointStore.job_meta(config, "kmeans", extra={
+                "kmeans_k": config.kmeans_k,
+                "kmeans_mode": "device" if device_mode else "stream",
+                "kmeans_shards": n_shards,
+                # backend changes float accumulation order (CPU XLA vs MXU)
+                # exactly like mode/shards do, so it is identity too
+                "kmeans_backend": config.backend,
+                # the digest pins the INITIAL centroids: a caller-provided
+                # init different from the snapshot's trajectory must
+                # invalidate, not be silently overridden
+                "kmeans_init": hashlib.sha256(
+                    centroids.tobytes()).hexdigest()[:16],
+            }))
+        snap = store.load_snapshot()
+        if snap is not None:
+            state, _d, start_iter, _n, _x = snap
+            centroids = np.asarray(state["centroids"], np.float32)
+            _log.info("k-means resumed at iteration %d", start_iter)
+
+        def _save(done: int, c: np.ndarray) -> None:
+            store.save_snapshot({"centroids": np.asarray(c, np.float32)},
+                                HashDictionary(), done, done)
     with metrics.phase("iterate"):
-        if device_mode:
-            n_shards = effective_num_shards(config)
+        remaining = config.kmeans_iters - start_iter
+        if remaining <= 0:
+            # snapshot already covers every requested iteration; the
+            # snapshot state IS the result (continue-training semantics —
+            # use a fresh checkpoint_dir to recompute from scratch)
+            if remaining < 0:
+                _log.warning(
+                    "checkpoint has %d iterations, more than the %d "
+                    "requested; returning the snapshotted state",
+                    start_iter, config.kmeans_iters)
+        elif device_mode:
+            on_iter = ((lambda i, c: _save(start_iter + i, c))
+                       if store else None)
             if n_shards > 1:
                 from map_oxidize_tpu.parallel.kmeans import kmeans_fit_sharded
 
                 centroids = kmeans_fit_sharded(
                     np.asarray(pts, np.float32), centroids,
-                    iters=config.kmeans_iters, num_shards=config.num_shards,
-                    backend=config.backend)
+                    iters=remaining, num_shards=config.num_shards,
+                    backend=config.backend, on_iter=on_iter)
             else:
                 from map_oxidize_tpu.workloads.kmeans import kmeans_fit_device
 
@@ -576,16 +627,18 @@ def run_kmeans_job(config: JobConfig, centroids: np.ndarray | None = None
 
                 centroids = kmeans_fit_device(
                     np.asarray(pts, np.float32), centroids,
-                    iters=config.kmeans_iters,
-                    device=pick_device(config.backend))
+                    iters=remaining,
+                    device=pick_device(config.backend), on_iter=on_iter)
         else:
-            for _ in range(config.kmeans_iters):
+            for it in range(start_iter, config.kmeans_iters):
                 engine = make_engine(config, SumReducer(),
                                      value_shape=(d + 1,),
                                      value_dtype=np.float32)
                 centroids = kmeans_iteration(
                     engine, centroids,
                     iter_point_chunks(config.input_path, rows))
+                if store:
+                    _save(it + 1, centroids)
     with metrics.phase("write"):
         if config.output_path:
             # write to the EXACT configured path (np.save(str) would append
@@ -596,10 +649,23 @@ def run_kmeans_job(config: JobConfig, centroids: np.ndarray | None = None
             with open(tmp, "wb") as f:
                 np.save(f, centroids)
             os.replace(tmp, config.output_path)
-    metrics.set("records_in", int(n) * config.kmeans_iters)
+    ran_iters = max(config.kmeans_iters - start_iter, 0)
+    if store:
+        # a zero-work run (the snapshot already covered every requested
+        # iteration) is a read of the continue-training state, not a
+        # completion of it — deleting the snapshot then would destroy
+        # training progress the run merely inspected
+        store.finish(config.keep_intermediates or ran_iters == 0)
+    # metrics reflect work THIS process performed: a resume that replayed a
+    # snapshot ran only the remaining iterations, so throughput numerators
+    # (records_in) must not count skipped ones.  `iters` is the number of
+    # iterations the returned centroids represent.
+    metrics.set("records_in", int(n) * ran_iters)
     metrics.set("points", int(n))
     metrics.set("dim", int(d))
-    metrics.set("iters", config.kmeans_iters)
+    metrics.set("iters", start_iter + ran_iters)
+    if start_iter:
+        metrics.set("resumed_iters", start_iter)
     result = KMeansResult(centroids=centroids, metrics=metrics.summary())
     if config.metrics:
         _log.info("metrics: %s", result.metrics)

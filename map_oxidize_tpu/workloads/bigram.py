@@ -41,13 +41,19 @@ class RescanDictionary(HashDictionary):
     hashes they are about to look up.
     """
 
-    __slots__ = ("_stream", "_path", "_chunk_bytes")
+    __slots__ = ("_stream", "_path", "_chunk_bytes", "_early_stop")
 
-    def __init__(self, stream, path: str, chunk_bytes: int):
+    def __init__(self, stream, path: str, chunk_bytes: int,
+                 early_stop: bool = True):
         super().__init__()
         self._stream = stream
         self._path = path
         self._chunk_bytes = chunk_bytes
+        #: stop the rescan once every queried hash has been seen (top-k
+        #: winners are the most frequent keys, so this typically ends within
+        #: the first chunks); config.rescan_full=True forces the whole-corpus
+        #: scan, which extends the collision byte-check to every occurrence
+        self._early_stop = early_stop
 
     def prefetch(self, hashes) -> None:
         hashes = np.asarray(hashes, np.uint64)
@@ -65,7 +71,8 @@ class RescanDictionary(HashDictionary):
         if missing.size == 0:
             return
         h, lens, blob = self._stream.resolve_file(
-            self._path, self._chunk_bytes, np.unique(missing))
+            self._path, self._chunk_bytes, np.unique(missing),
+            early_stop=self._early_stop)
         self.add_arrays(h, lens, blob)
         self._flush()
 
@@ -99,9 +106,9 @@ class BigramMapper(Mapper):
     def supports_hash_only(self) -> bool:
         return self._native is not None
 
-    def rescan_dictionary(self, path: str, chunk_bytes: int
-                          ) -> RescanDictionary:
-        return RescanDictionary(self._native, path, chunk_bytes)
+    def rescan_dictionary(self, path: str, chunk_bytes: int,
+                          early_stop: bool = True) -> RescanDictionary:
+        return RescanDictionary(self._native, path, chunk_bytes, early_stop)
 
     def map_file(self, path: str, chunk_bytes: int, start_offset: int = 0):
         """Native mmap fast path (see WordCountMapper.map_file)."""

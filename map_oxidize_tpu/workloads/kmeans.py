@@ -138,10 +138,18 @@ def kmeans_model(points: np.ndarray, centroids: np.ndarray) -> np.ndarray:
     return new
 
 
-def kmeans_fit_device(points, centroids, iters: int = 1, device=None):
+def kmeans_fit_device(points, centroids, iters: int = 1, device=None,
+                      on_iter=None):
     """HBM-resident k-means: points transfer once, ``iters`` iterations run
     entirely on device (distance matmul + one-hot matmul partial sums — both
-    MXU work).  Returns the final centroids as NumPy."""
+    MXU work).  Returns the final centroids as NumPy.
+
+    ``on_iter(i, centroids_np)`` (checkpoint hook): when given, iterations
+    step one at a time python-side — points stay in HBM, only the tiny
+    ``(k, d)`` centroids cross back per iteration — and the hook sees the
+    state after each.  The per-step jit runs the same compiled body the
+    ``fori_loop`` path runs, so enabling checkpointing costs one dispatch
+    per iteration, not a different computation."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -172,7 +180,13 @@ def kmeans_fit_device(points, centroids, iters: int = 1, device=None):
         device = jax.devices()[0]
     p_dev = jax.device_put(points, device)
     c_dev = jax.device_put(np.asarray(centroids, np.float32), device)
-    return np.asarray(fit(c_dev, p_dev))
+    if on_iter is None:
+        return np.asarray(fit(c_dev, p_dev))
+    c = c_dev
+    for i in range(iters):
+        c = step(c, p_dev)
+        on_iter(i + 1, np.asarray(c))
+    return np.asarray(c)
 
 
 def make_kmeans(centroids: np.ndarray):

@@ -58,6 +58,46 @@ def test_rescan_dictionary_lookup_miss_raises(tmp_path):
         d.lookup(12345)  # hash of nothing in the corpus
 
 
+def test_early_stop_resolve_matches_full_scan(tmp_path):
+    """The early-exit rescan (stop once every queried hash is seen) must
+    return exactly the strings the full-corpus scan returns — for frequent
+    winners AND for a key whose only occurrence is the corpus's last pair,
+    where the "early" stop is the natural end of file."""
+    p = tmp_path / "c.txt"
+    p.write_bytes(CORPUS + b"unique1 unique2\n")
+    from map_oxidize_tpu.native.bindings import stream_or_none
+    from map_oxidize_tpu.ops.hashing import moxt64_bytes
+
+    queries = np.array([moxt64_bytes(b"the cat"),
+                        moxt64_bytes(b"unique1 unique2")], np.uint64)
+    stream = stream_or_none(ngram=2)
+    # small chunks so early exit has somewhere to stop between chunks
+    full = stream.resolve_file(str(p), 1 << 10, queries, early_stop=False)
+    early = stream.resolve_file(str(p), 1 << 10, queries, early_stop=True)
+    as_dict = lambda r: {int(h): bytes(r[2][sum(r[1][:i]):sum(r[1][:i + 1])])
+                         for i, h in enumerate(r[0].tolist())}
+    assert as_dict(full) == as_dict(early)
+    assert set(as_dict(full)) == {int(q) for q in queries}
+
+
+def test_early_stop_quits_before_eof(tmp_path):
+    """Observable proof the early stop really skips the tail: under the
+    unicode tokenizer a full scan of a corpus with an invalid-UTF-8 tail
+    raises, but with every queried key found in the first chunks the
+    early-stop scan never reaches the bad bytes."""
+    p = tmp_path / "c.txt"
+    p.write_bytes(CORPUS + b"\xff\xfe broken tail \xff\n")
+    from map_oxidize_tpu.native.bindings import stream_or_none
+    from map_oxidize_tpu.ops.hashing import moxt64_bytes
+
+    stream = stream_or_none(ngram=2, tokenizer="unicode")
+    q = np.array([moxt64_bytes(b"the cat")], np.uint64)
+    h, lens, blob = stream.resolve_file(str(p), 1 << 10, q, early_stop=True)
+    assert h.tolist() == [int(q[0])] and blob == b"the cat"
+    with pytest.raises(Exception):
+        stream.resolve_file(str(p), 1 << 10, q, early_stop=False)
+
+
 def test_round_robin_mode_keeps_string_path(tmp_path):
     # round-robin chunking has no byte cuts to replay: hash-only must stay off
     res, mapper = _run(tmp_path, num_chunks=4)

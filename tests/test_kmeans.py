@@ -145,3 +145,158 @@ def test_run_kmeans_job_device_paths(tmp_path, rng):
     dev8 = run("device", 8)
     np.testing.assert_allclose(dev1, streamed, rtol=1e-3, atol=1e-3)
     np.testing.assert_allclose(dev8, streamed, rtol=1e-3, atol=1e-3)
+
+
+# --- checkpoint/resume (round-3: closes the last warn-and-run hole) -------
+
+def _ck_cfg(inp, iters, ckdir, **kw):
+    base = dict(input_path=str(inp), output_path="", backend="cpu",
+                kmeans_k=3, kmeans_iters=iters, chunk_bytes=4096,
+                checkpoint_dir=ckdir, metrics=False)
+    base.update(kw)
+    return JobConfig(**base)
+
+
+def test_kmeans_checkpoint_resume_streamed(tmp_path, rng, monkeypatch):
+    """A 2-iteration run's snapshot resumes a 5-iteration job at iteration
+    2 (only 3 more run) and the result is byte-identical to an
+    uninterrupted checkpointed 5-iteration run."""
+    import os
+
+    pts, _ = _blobs(rng, n=1200, d=4, k=3)
+    inp = tmp_path / "p.npy"
+    np.save(inp, pts)
+
+    want = run_job(_ck_cfg(inp, 5, str(tmp_path / "ck_ref")),
+                   "kmeans").centroids
+
+    ck = str(tmp_path / "ck")
+    run_job(_ck_cfg(inp, 2, ck, keep_intermediates=True), "kmeans")
+    assert os.path.isfile(os.path.join(ck, "snapshot.npz"))
+
+    import map_oxidize_tpu.workloads.kmeans as wk
+
+    calls = {"n": 0}
+    orig = wk.kmeans_iteration
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(wk, "kmeans_iteration", counting)
+    got = run_job(_ck_cfg(inp, 5, ck), "kmeans").centroids
+    assert calls["n"] == 3, "resume must skip the 2 snapshotted iterations"
+    assert got.tobytes() == want.tobytes()
+    assert not os.path.isdir(ck)  # success removes the spill by default
+
+
+def test_kmeans_checkpoint_resume_device(tmp_path, rng):
+    """Device (HBM-resident) path: per-iteration snapshots via on_iter;
+    interrupted-at-2 then resumed-to-4 equals uninterrupted checkpointed 4."""
+    import os
+
+    pts, _ = _blobs(rng, n=900, d=4, k=3)
+    inp = tmp_path / "p.npy"
+    np.save(inp, pts)
+
+    want = run_job(_ck_cfg(inp, 4, str(tmp_path / "ck_ref"),
+                           mapper="device", num_shards=1),
+                   "kmeans").centroids
+    ck = str(tmp_path / "ck")
+    run_job(_ck_cfg(inp, 2, ck, mapper="device", num_shards=1,
+                    keep_intermediates=True), "kmeans")
+    got = run_job(_ck_cfg(inp, 4, ck, mapper="device", num_shards=1),
+                  "kmeans").centroids
+    assert got.tobytes() == want.tobytes()
+    assert not os.path.isdir(ck)
+
+
+def test_kmeans_checkpoint_identity_mismatch_discards(tmp_path, rng):
+    """A snapshot from a different k (or mode) must be discarded, not
+    resumed: the k=4 run starts fresh and matches a no-checkpoint run."""
+    pts, _ = _blobs(rng, n=800, d=4, k=4)
+    inp = tmp_path / "p.npy"
+    np.save(inp, pts)
+    ck = str(tmp_path / "ck")
+
+    run_job(_ck_cfg(inp, 2, ck, keep_intermediates=True), "kmeans")  # k=3
+    got = run_job(_ck_cfg(inp, 2, ck, kmeans_k=4), "kmeans").centroids
+    want = run_job(_ck_cfg(inp, 2, None, kmeans_k=4), "kmeans").centroids
+    assert got.tobytes() == want.tobytes()
+
+
+def test_kmeans_snapshot_covers_all_requested_iters(tmp_path, rng):
+    """Resume where the snapshot already has >= kmeans_iters iterations:
+    no iteration runs, the snapshot centroids are the result, and the
+    zero-work run must NOT delete the training state it merely read
+    (code-review finding, round 3)."""
+    import os
+
+    pts, _ = _blobs(rng, n=600, d=3, k=3)
+    inp = tmp_path / "p.npy"
+    np.save(inp, pts)
+    ck = str(tmp_path / "ck")
+    want = run_job(_ck_cfg(inp, 3, ck, keep_intermediates=True),
+                   "kmeans").centroids
+    got = run_job(_ck_cfg(inp, 2, ck), "kmeans").centroids  # 2 < 3 done
+    assert got.tobytes() == want.tobytes()
+    assert os.path.isfile(os.path.join(ck, "snapshot.npz")), \
+        "a zero-work read must preserve the continue-training snapshot"
+    # ...and the preserved state still resumes a longer job, then cleans up
+    run_job(_ck_cfg(inp, 5, ck), "kmeans")
+    assert not os.path.isdir(ck)
+
+
+def test_kmeans_explicit_init_invalidates_foreign_snapshot(tmp_path, rng):
+    """A snapshot from a different initial-centroid trajectory must be
+    discarded, not silently resumed over the caller's init (code-review
+    finding, round 3)."""
+    pts, _ = _blobs(rng, n=500, d=3, k=3)
+    inp = tmp_path / "p.npy"
+    np.save(inp, pts)
+    ck = str(tmp_path / "ck")
+    init_a = np.asarray(pts[:3], np.float32)
+    init_b = np.asarray(pts[10:13], np.float32) + 1.0
+
+    run_kmeans_job(_ck_cfg(inp, 2, ck, keep_intermediates=True),
+                   centroids=init_a)
+    got = run_kmeans_job(_ck_cfg(inp, 2, ck), centroids=init_b).centroids
+    want = run_kmeans_job(_ck_cfg(inp, 2, None), centroids=init_b).centroids
+    assert got.tobytes() == want.tobytes()
+
+
+def test_kmeans_checkpoint_resume_sharded(tmp_path, rng):
+    """Sharded HBM-resident path (kmeans_fit_sharded + on_iter): resume on
+    the 8-device virtual mesh is byte-identical to an uninterrupted
+    checkpointed run, and metrics count only the iterations actually run."""
+    import os
+
+    pts, _ = _blobs(rng, n=1001, d=4, k=3)  # odd n: pad rows live
+    inp = tmp_path / "p.npy"
+    np.save(inp, pts)
+
+    kw = dict(mapper="device", num_shards=8)
+    want = run_job(_ck_cfg(inp, 4, str(tmp_path / "ck_ref"), **kw),
+                   "kmeans").centroids
+    ck = str(tmp_path / "ck")
+    run_job(_ck_cfg(inp, 2, ck, keep_intermediates=True, **kw), "kmeans")
+    res = run_job(_ck_cfg(inp, 4, ck, **kw), "kmeans")
+    assert res.centroids.tobytes() == want.tobytes()
+    assert not os.path.isdir(ck)
+
+
+def test_kmeans_resume_metrics_count_only_ran_iters(tmp_path, rng):
+    """records_in/iters after a resume: throughput numerators must not be
+    inflated by snapshotted iterations (code-review finding, round 3)."""
+    pts, _ = _blobs(rng, n=500, d=3, k=3)
+    inp = tmp_path / "p.npy"
+    np.save(inp, pts)
+    ck = str(tmp_path / "ck")
+
+    run_job(_ck_cfg(inp, 2, ck, keep_intermediates=True), "kmeans")
+    cfg = _ck_cfg(inp, 5, ck)
+    cfg.metrics = True
+    res = run_job(cfg, "kmeans")
+    assert res.metrics["records_in"] == 500 * 3   # only 3 iterations ran
+    assert res.metrics["iters"] == 5              # result represents 5
+    assert res.metrics["resumed_iters"] == 2
