@@ -403,8 +403,10 @@ class NativeStream:
         hashes = np.empty(n, np.uint64)
         if n:
             self._lib.moxt_hashes_read(self._st, hashes.ctypes.data)
-        hi, lo = split_u64(hashes)
-        return MapOutput(hi=hi, lo=lo, values=np.ones(n, np.int32),
+        # compact form: keys64 only — no plane split, no ones array (counts
+        # are implicit).  The host collect engine consumes this directly;
+        # anything plane-bound calls out.ensure_planes().
+        return MapOutput(hi=None, lo=None, values=None,
                          dictionary=HashDictionary(), records_in=n,
                          keys64=hashes)
 

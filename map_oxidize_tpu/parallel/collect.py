@@ -168,6 +168,7 @@ class ShardedCollectEngine:
         self.rows_fed += n
         if n == 0:
             return
+        out.ensure_planes()  # no-op except for compact keys64-only outputs
         vals = out.values
         if vals.ndim != 2 or vals.shape[1] != 2 or vals.dtype != np.uint32:
             raise ValueError("collect engines expect (n, 2) uint32 doc planes")

@@ -242,6 +242,7 @@ def run_distributed_wordcount(config: JobConfig, workload: str = "wordcount"):
             except StopIteration:
                 exhausted = True
                 break
+            out.ensure_planes()  # no-op except for compact keys64 outputs
             stage_hi.append(out.hi)
             stage_lo.append(out.lo)
             stage_vals.append(np.asarray(out.values, np.int32))

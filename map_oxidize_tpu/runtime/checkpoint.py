@@ -154,6 +154,7 @@ class CheckpointStore:
         surviving the journal replay (rename-before-data is a real ext4
         ordering); replay() additionally treats an unloadable chunk as the
         end of the contiguous prefix rather than an opaque np.load error."""
+        out.ensure_planes()  # compact keys64-only outputs spill as planes
         hashes, lens, blob = out.dictionary.to_arrays()
         fd, tmp = tempfile.mkstemp(suffix=".npz.tmp", dir=self.dir)
         try:

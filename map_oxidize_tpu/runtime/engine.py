@@ -167,6 +167,7 @@ class StreamingEngineBase(abc.ABC):
         self.rows_fed += rows
         if rows == 0:
             return
+        out.ensure_planes()  # no-op except for compact keys64-only outputs
         self._stage.append((out.hi, out.lo, out.values))
         self._staged += rows
         if self._staged >= self.feed_batch:

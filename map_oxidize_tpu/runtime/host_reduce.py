@@ -85,7 +85,10 @@ class HostCollectReduceEngine:
             return
         k64 = out.keys64 if out.keys64 is not None else join_u64(out.hi, out.lo)
         self._keys.append(k64)
-        self._vals.append(np.asarray(out.values, self.value_dtype))
+        # None = implicit all-ones (the hash-only compact form): no 136MB of
+        # ones to allocate, concatenate, and re-scan at finalize
+        self._vals.append(None if out.values is None
+                          else np.asarray(out.values, self.value_dtype))
         if self.rows_fed > self.max_rows:
             raise RuntimeError(
                 f"HostCollectReduceEngine exceeded max_rows={self.max_rows}; "
@@ -107,9 +110,18 @@ class HostCollectReduceEngine:
                 self._reduced = (e, np.empty(0, self.value_dtype))
             else:
                 keys = np.concatenate(self._keys)
-                vals = np.concatenate(self._vals)
+                if all(v is None for v in self._vals):
+                    vals = None  # implicit all-ones, nothing to materialize
+                else:
+                    # the comprehension equals plain concatenation when all
+                    # blocks are explicit; mixed blocks fill in their ones
+                    vals = np.concatenate(
+                        [np.ones(k.shape[0], self.value_dtype)
+                         if v is None else v
+                         for k, v in zip(self._keys, self._vals)])
                 self._keys = self._vals = None  # free the blocks
-                if self.combine == "sum" and bool(np.all(vals == 1)):
+                if self.combine == "sum" and (
+                        vals is None or bool(np.all(vals == 1))):
                     # hash-only count path: every row weighs 1, so counts
                     # are segment lengths — sort the keys alone and diff
                     # the boundaries.  The native radix sort beats both
@@ -129,6 +141,8 @@ class HostCollectReduceEngine:
                                      counts.astype(self.value_dtype,
                                                    copy=False))
                     return self._reduced
+                if vals is None:  # implicit ones outside the sum fast path
+                    vals = np.ones(keys.shape[0], self.value_dtype)
                 order = np.argsort(keys, kind="stable")
                 keys = keys[order]
                 vals = vals[order]
