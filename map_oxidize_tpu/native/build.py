@@ -140,6 +140,9 @@ def _load_lib():
         lib.moxt_sort_kd.restype = ctypes.c_int32
         lib.moxt_sort_kd.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                                      ctypes.c_int64]
+        lib.moxt_count_u64.restype = ctypes.c_int64
+        lib.moxt_count_u64.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                       ctypes.c_void_p, ctypes.c_void_p]
         _lib = lib
         return _lib
 
@@ -549,6 +552,39 @@ def sort_kd_or_none(keys: np.ndarray, docs: np.ndarray | None):
                      "falling back to numpy")
         return False
     return True
+
+
+def count_u64_or_none(keys: np.ndarray):
+    """Fused unique+count of u64 hash keys (the hash-only count reduce):
+    MSD partition + per-bucket in-cache LSD + run emission in one native
+    call — ~3x less DRAM traffic than sort + boundary-scan + gather.
+    ``keys`` is read-only (the output buffer doubles as partition
+    scratch).  Returns ``(uniques, counts)`` with uniques ascending, or
+    None when the native library is unavailable / input unsuitable /
+    scratch allocation fails (caller falls back to the sort path).
+    n >= 2^31 is refused: one key with that many occurrences would
+    truncate its int32 count."""
+    try:
+        lib = _load_lib()
+    except Exception:
+        return None
+    if not (keys.dtype == np.dtype(np.uint64) and keys.ndim == 1
+            and keys.flags.c_contiguous):
+        return None
+    n = int(keys.shape[0])
+    if n >= 1 << 31:
+        return None
+    if n == 0:
+        return np.empty(0, np.uint64), np.empty(0, np.int32)
+    out_k = np.empty(n, np.uint64)
+    out_c = np.empty(n, np.int32)
+    m = int(lib.moxt_count_u64(keys.ctypes.data, n, out_k.ctypes.data,
+                               out_c.ctypes.data))
+    if m < 0:
+        _log.warning("native count_u64 could not allocate scratch; "
+                     "falling back to sort")
+        return None
+    return out_k[:m].copy(), out_c[:m].copy()
 
 
 class StreamPool:

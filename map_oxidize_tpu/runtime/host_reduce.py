@@ -123,20 +123,36 @@ class HostCollectReduceEngine:
                 if self.combine == "sum" and (
                         vals is None or bool(np.all(vals == 1))):
                     # hash-only count path: every row weighs 1, so counts
-                    # are segment lengths — sort the keys alone and diff
-                    # the boundaries.  The native radix sort beats both
-                    # np.unique and np.sort at these sizes; numpy remains
-                    # the fallback.
-                    from map_oxidize_tpu.native.build import sort_kd_or_none
+                    # are run lengths.  Two native formulations, winner by
+                    # key-space shape (measured, 34M keys, benchmarks/
+                    # RESULTS.md round 3): the fused MSD+in-cache-LSD
+                    # unique+count saves ~3x DRAM traffic and wins on
+                    # mostly-UNIQUE keys (4.6 vs 6.4s); duplicate-heavy
+                    # keys (Zipf bigrams, 5:1) invert it (2.9 vs 2.3s) —
+                    # equal-key runs give the plain LSD scatter write
+                    # locality the bucket partition cannot exploit.  A 64k
+                    # stride sample picks the side; np.unique stays the
+                    # no-native fallback.
+                    from map_oxidize_tpu.native.build import (
+                        count_u64_or_none,
+                        sort_kd_or_none,
+                    )
 
-                    if self.config.use_native and sort_kd_or_none(keys, None):
+                    uniq = counts = None
+                    n_rows = int(keys.shape[0])
+                    if self.config.use_native and n_rows > (1 << 20):
+                        samp = keys[::max(n_rows // 65536, 1)]
+                        if np.unique(samp).shape[0] >= 0.98 * samp.shape[0]:
+                            uc = count_u64_or_none(keys)
+                            if uc is not None:
+                                uniq, counts = uc
+                    if uniq is None and self.config.use_native \
+                            and sort_kd_or_none(keys, None):
                         bounds = self._segment_bounds(keys)
                         counts = np.diff(np.append(bounds, keys.shape[0]))
-                        self._reduced = (
-                            keys[bounds],
-                            counts.astype(self.value_dtype, copy=False))
-                        return self._reduced
-                    uniq, counts = np.unique(keys, return_counts=True)
+                        uniq = keys[bounds]
+                    if uniq is None:
+                        uniq, counts = np.unique(keys, return_counts=True)
                     self._reduced = (uniq,
                                      counts.astype(self.value_dtype,
                                                    copy=False))

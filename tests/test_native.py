@@ -81,3 +81,28 @@ def test_trigram_sanity():
     got = {dd[h]: v for h, v in zip(k, out.values.tolist())}
     assert got == {b"a b c": 1, b"b c d": 1}
     assert out.records_in == 2
+
+
+def test_count_u64_matches_numpy_unique():
+    """Fused MSD+LSD unique+count == np.unique across shapes that stress
+    it: uniform hashes, heavy Zipf duplicates (one bucket >> cache), all
+    same key, single key, and empty."""
+    from map_oxidize_tpu.native.build import count_u64_or_none
+
+    rng = np.random.default_rng(17)
+    cases = [
+        rng.integers(0, 2**64, size=100_000, dtype=np.uint64),        # uniform
+        rng.choice(rng.integers(0, 2**64, size=50, dtype=np.uint64),
+                   size=200_000).astype(np.uint64),                   # hot keys
+        np.full(10_000, 0xDEADBEEFCAFEBABE, np.uint64),               # one key
+        np.array([7], np.uint64),
+        np.empty(0, np.uint64),
+    ]
+    for keys in cases:
+        want_u, want_c = np.unique(keys, return_counts=True)
+        got = count_u64_or_none(keys.copy())
+        if got is None:
+            pytest.skip("native library unavailable")
+        got_u, got_c = got
+        np.testing.assert_array_equal(got_u, want_u)
+        np.testing.assert_array_equal(got_c.astype(np.int64), want_c)
