@@ -52,6 +52,11 @@ class MapOutput:
     #: hold the 64-bit form may pass it so host-side engines skip the
     #: join; device engines ignore it (they consume the 32-bit planes).
     keys64: np.ndarray | None = None
+    #: optional joined int64 doc ids (pair outputs, compact form): the
+    #: host collect engine consumes these directly; ``values`` then stays
+    #: None until a plane-bound consumer materializes the (n, 2) uint32
+    #: doc planes via :meth:`ensure_planes`.
+    docs64: np.ndarray | None = None
 
     def __len__(self) -> int:
         if self.hi is not None:
@@ -59,14 +64,22 @@ class MapOutput:
         return int(self.keys64.shape[0])
 
     def ensure_planes(self) -> None:
-        """Materialize ``hi``/``lo`` (and implicit all-ones ``values``) from
-        ``keys64`` for consumers bound to the 32-bit-plane contract."""
+        """Materialize ``hi``/``lo`` (and ``values``: the (n, 2) doc planes
+        for pair outputs, implicit all-ones counts otherwise) from the
+        compact 64-bit form for consumers bound to the plane contract."""
         if self.hi is None:
             from map_oxidize_tpu.ops.hashing import split_u64
 
             self.hi, self.lo = split_u64(self.keys64)
         if self.values is None:
-            self.values = np.ones(len(self), np.int32)
+            if self.docs64 is not None:
+                du = self.docs64.view(np.uint64)
+                v = np.empty((len(self), 2), np.uint32)
+                v[:, 0] = (du >> np.uint64(32)).astype(np.uint32)
+                v[:, 1] = (du & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+                self.values = v
+            else:
+                self.values = np.ones(len(self), np.int32)
 
 
 class Mapper(abc.ABC):

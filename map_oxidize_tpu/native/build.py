@@ -143,6 +143,11 @@ def _load_lib():
         lib.moxt_count_u64.restype = ctypes.c_int64
         lib.moxt_count_u64.argtypes = [ctypes.c_void_p, ctypes.c_int64,
                                        ctypes.c_void_p, ctypes.c_void_p]
+        lib.moxt_group_by_key.restype = ctypes.c_int32
+        lib.moxt_group_by_key.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_void_p]
         _lib = lib
         return _lib
 
@@ -340,14 +345,11 @@ class NativeStream:
             self._lib.moxt_pairs_read(self._st, hashes.ctypes.data,
                                       docs.ctypes.data)
         d = self._drain_dict_locked()
-        hi, lo = split_u64(hashes)
-        # doc ids ride as two uint32 planes (the engine sorts 32-bit lanes)
-        du = docs.view(np.uint64)
-        vals = np.empty((n, 2), np.uint32)
-        vals[:, 0] = (du >> np.uint64(32)).astype(np.uint32)
-        vals[:, 1] = (du & np.uint64(0xFFFFFFFF)).astype(np.uint32)
-        return MapOutput(hi=hi, lo=lo, values=vals, dictionary=d,
-                         records_in=n_tokens)
+        # compact form: the host collect engine consumes (keys64, docs64)
+        # directly; plane-bound consumers (checkpoint spill, device sort)
+        # materialize hi/lo + (n, 2) doc planes via ensure_planes()
+        return MapOutput(hi=None, lo=None, values=None, dictionary=d,
+                         records_in=n_tokens, keys64=hashes, docs64=docs)
 
     def map_docs(self, chunk, base_doc: int = 0) -> MapOutput:
         """Inverted-index map of one chunk: one row per distinct term per
@@ -585,6 +587,49 @@ def count_u64_or_none(keys: np.ndarray):
                      "falling back to sort")
         return None
     return out_k[:m].copy(), out_c[:m].copy()
+
+
+def group_by_key_or_none(keys: np.ndarray, docs: np.ndarray,
+                         uniq: np.ndarray):
+    """Group ``docs`` by ``keys`` against the known distinct-key set
+    ``uniq`` (ascending u64) — the inverted-index finalize without a sort:
+    an L2-resident hash->dense-id table, a counting pass, a scatter pass
+    (feed order per term preserved = ascending doc ids, the sort path's
+    stability contract).  Returns ``(offsets i64[m+1], docs_grouped
+    i64[n])`` or None when the native library is unavailable, dtypes are
+    unsuitable, scratch allocation fails, or the contract is violated
+    (duplicate uniq entry / key missing from uniq) — callers fall back to
+    the sort path."""
+    try:
+        lib = _load_lib()
+    except Exception:
+        return None
+
+    def _ok(a, dt):
+        return (a.dtype == np.dtype(dt) and a.ndim == 1
+                and a.flags.c_contiguous)
+
+    if not (_ok(keys, np.uint64) and _ok(docs, np.int64)
+            and _ok(uniq, np.uint64) and docs.shape == keys.shape):
+        return None
+    n = int(keys.shape[0])
+    m = int(uniq.shape[0])
+    if m == 0:
+        return None
+    out_off = np.empty(m + 1, np.int64)
+    out_docs = np.empty(max(n, 1), np.int64)
+    rc = int(lib.moxt_group_by_key(
+        keys.ctypes.data, docs.ctypes.data, n, uniq.ctypes.data, m,
+        out_off.ctypes.data, out_docs.ctypes.data))
+    if rc == -1:
+        _log.warning("native group_by_key could not allocate scratch; "
+                     "falling back to sort")
+        return None
+    if rc:
+        _log.warning("group_by_key contract violation (dictionary does not "
+                     "exactly cover the fed keys); falling back to sort")
+        return None
+    return out_off, out_docs[:n]
 
 
 class StreamPool:

@@ -86,11 +86,19 @@ class CollectEngine:
         self.rows_fed += n
         if n == 0:
             return
-        out.ensure_planes()  # no-op except for compact keys64-only outputs
-        vals = out.values
-        if vals.ndim != 2 or vals.shape[1] != 2 or vals.dtype != np.uint32:
-            raise ValueError("CollectEngine expects (n, 2) uint32 doc planes")
-        self._stage.append((out.hi, out.lo, vals))
+        if (self.sort_mode == "host" and out.keys64 is not None
+                and out.docs64 is not None):
+            # compact pair form: consumed as-is by the host finalize —
+            # no plane split here, no re-join there
+            self._stage.append(("c", out.keys64, out.docs64))
+        else:
+            out.ensure_planes()  # no-op except for compact outputs
+            vals = out.values
+            if (vals.ndim != 2 or vals.shape[1] != 2
+                    or vals.dtype != np.uint32):
+                raise ValueError(
+                    "CollectEngine expects (n, 2) uint32 doc planes")
+            self._stage.append(("p", out.hi, out.lo, vals))
         self._staged += n
         if self.rows_fed > self.max_rows:
             raise RuntimeError(
@@ -102,9 +110,9 @@ class CollectEngine:
     def flush(self) -> None:
         if self.sort_mode == "host" or not self._staged:
             return
-        hi = np.concatenate([s[0] for s in self._stage])
-        lo = np.concatenate([s[1] for s in self._stage])
-        vals = np.concatenate([s[2] for s in self._stage])
+        hi = np.concatenate([s[1] for s in self._stage])
+        lo = np.concatenate([s[2] for s in self._stage])
+        vals = np.concatenate([s[3] for s in self._stage])
         self._stage = []
         self._staged = 0
         for start in range(0, hi.shape[0], self.feed_batch):
@@ -119,34 +127,98 @@ class CollectEngine:
             self._batches.append(jax.device_put(packed, self.device))
             self._batch_rows.append(n)
 
+    def _host_columns(self):
+        """Consume the stage into joined u64 key / i64 doc columns.
+        Compact blocks pass through; plane blocks (python mapper,
+        checkpoint replay) join here.  Returns ``(keys, docs, owned)`` —
+        a single compact block aliases the caller's MapOutput arrays
+        (``owned=False``), so in-place consumers must copy first."""
+        ks, ds = [], []
+        for blk in self._stage:
+            if blk[0] == "c":
+                ks.append(blk[1])
+                ds.append(blk[2])
+            else:
+                _, hi, lo, v = blk
+                ks.append((hi.astype(np.uint64) << np.uint64(32)) | lo)
+                ds.append(((v[:, 0].astype(np.uint64) << np.uint64(32))
+                           | v[:, 1]).view(np.int64))
+        aliased = len(self._stage) == 1 and self._stage[0][0] == "c"
+        self._stage, self._staged = [], 0
+        if len(ks) == 1:  # single block: no concat copy
+            return ks[0], ds[0], not aliased
+        return np.concatenate(ks), np.concatenate(ds), True
+
+    def _sorted_host_pairs(self, keys, docs, owned=True):
+        """STABLE sort by key alone: rows arrive in ascending doc order
+        per term by construction (chunks stream in file order; within
+        a chunk the mapper scans documents in line order), so
+        stability alone yields (key, doc)-sorted rows.  The native
+        LSD radix (docs riding the scatter) measures ~4x numpy's
+        stable argsort at 30M rows; numpy remains the fallback.
+        The parity suites (vs the independent oracle) pin the
+        ascending-doc invariant; a mapper that emitted docs out of
+        order would fail them."""
+        from map_oxidize_tpu.native.build import sort_kd_or_none
+
+        if self.config.use_native:
+            if not owned:
+                # the native sort is in-place; never reorder arrays that
+                # still alias a caller's MapOutput
+                keys, docs = keys.copy(), docs.copy()
+            if sort_kd_or_none(keys, docs):
+                return keys, docs
+        order = np.argsort(keys, kind="stable")
+        return keys[order], docs[order]
+
+    def finalize_csr(self, uniq_sorted: np.ndarray | None):
+        """CSR finalize ``(terms, offsets, docs_grouped)`` for term spaces
+        the map-phase dictionary already enumerates: distinct terms are
+        known, so grouping needs no sort — the native hash->dense-id
+        group-by runs two streaming passes instead of the radix sort's six
+        (measured: benchmarks/RESULTS.md round 3).  Consumes the stage.
+        Falls back internally to sort + boundary-scan (identical CSR) when
+        the native path is unavailable or the dictionary does not exactly
+        cover the fed keys; returns None only in device-sort mode (caller
+        uses :meth:`finalize`)."""
+        if self.sort_mode != "host":
+            return None
+        if not self._stage:
+            e = np.empty(0, np.uint64)
+            return e, np.zeros(1, np.int64), np.empty(0, np.int64)
+        keys, docs, owned = self._host_columns()
+        if (uniq_sorted is not None and self.config.use_native
+                and uniq_sorted.shape[0] <= max(keys.shape[0] // 8, 1)):
+            from map_oxidize_tpu.native.build import group_by_key_or_none
+
+            got = group_by_key_or_none(keys, docs, uniq_sorted)
+            if got is not None:
+                offsets, grouped = got
+                df = np.diff(offsets)
+                if not bool(np.all(df > 0)):
+                    # dictionary superset (e.g. replayed chunks whose rows
+                    # were deduplicated away): drop zero-count terms so the
+                    # CSR matches the sort path exactly
+                    live = df > 0
+                    uniq_sorted = uniq_sorted[live]
+                    offsets = np.concatenate(
+                        [[0], np.cumsum(df[live])]).astype(np.int64)
+                return uniq_sorted, offsets, grouped
+        keys, docs = self._sorted_host_pairs(keys, docs, owned)
+        bounds = (np.flatnonzero(np.concatenate(
+            [[True], keys[1:] != keys[:-1]])) if keys.shape[0]
+            else np.empty(0, np.int64))
+        return (keys[bounds],
+                np.append(bounds, keys.shape[0]).astype(np.int64), docs)
+
     def finalize(self):
         """One sort over everything fed; returns host arrays
         ``(keys_u64, docs_i64)`` sorted by (key, doc) with padding dropped."""
         if self.sort_mode == "host":
             if not self._stage:
                 return np.empty(0, np.uint64), np.empty(0, np.int64)
-            keys = ((np.concatenate([s[0] for s in self._stage])
-                     .astype(np.uint64) << np.uint64(32))
-                    | np.concatenate([s[1] for s in self._stage]))
-            v = np.concatenate([s[2] for s in self._stage])
-            self._stage, self._staged = [], 0
-            docs = ((v[:, 0].astype(np.uint64) << np.uint64(32))
-                    | v[:, 1]).view(np.int64)
-            # STABLE sort by key alone: rows arrive in ascending doc order
-            # per term by construction (chunks stream in file order; within
-            # a chunk the mapper scans documents in line order), so
-            # stability alone yields (key, doc)-sorted rows.  The native
-            # LSD radix (docs riding the scatter) measures ~4x numpy's
-            # stable argsort at 30M rows; numpy remains the fallback.
-            # The parity suites (vs the independent oracle) pin the
-            # ascending-doc invariant; a mapper that emitted docs out of
-            # order would fail them.
-            from map_oxidize_tpu.native.build import sort_kd_or_none
-
-            if self.config.use_native and sort_kd_or_none(keys, docs):
-                return keys, docs
-            order = np.argsort(keys, kind="stable")
-            return keys[order], docs[order]
+            keys, docs, owned = self._host_columns()
+            return self._sorted_host_pairs(keys, docs, owned)
         self.flush()
         total = sum(self._batch_rows)
         if total == 0:

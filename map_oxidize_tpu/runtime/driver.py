@@ -418,6 +418,7 @@ def run_inverted_index_job(config: JobConfig) -> InvertedIndexResult:
     order — deterministic, unlike anything the reference's nondeterministic
     HashMap ordering could produce (main.rs:170-182)."""
     from map_oxidize_tpu.workloads.inverted_index import (
+        Postings,
         make_inverted_index,
         postings_from_sorted,
     )
@@ -487,8 +488,27 @@ def run_inverted_index_job(config: JobConfig) -> InvertedIndexResult:
                 ckpt.save(resume_k + i, out, next_off)
 
     with metrics.phase("sort+postings"):
-        keys, docs = engine.finalize()
-        postings = postings_from_sorted(keys, docs, dictionary)
+        # the map-phase dictionary enumerates every distinct term, so the
+        # host finalize can GROUP instead of SORT (engine.finalize_csr:
+        # native hash->dense-id group-by, two streaming passes vs six radix
+        # scatter passes); sharded / device-sort engines keep the sorted-
+        # pairs path
+        csr = None
+        if (hasattr(engine, "finalize_csr")
+                and getattr(engine, "sort_mode", "") == "host"
+                and config.use_native
+                and len(dictionary) <= max(engine.rows_fed // 8, 1)):
+            # gates mirror finalize_csr's own: don't flush/sort the whole
+            # vocabulary for a device-sort or no-native run that would
+            # throw it away
+            d = dictionary.materialized()
+            uniq = np.sort(np.fromiter(d.keys(), np.uint64, count=len(d)))
+            csr = engine.finalize_csr(uniq)
+        if csr is not None:
+            postings = Postings(*csr, dictionary)
+        else:
+            keys, docs = engine.finalize()
+            postings = postings_from_sorted(keys, docs, dictionary)
 
     with metrics.phase("write"):
         if config.output_path:
@@ -500,7 +520,7 @@ def run_inverted_index_job(config: JobConfig) -> InvertedIndexResult:
         ckpt.finish(config.keep_intermediates)
 
     metrics.set("records_in", records_in)
-    metrics.set("pairs", int(keys.shape[0]))
+    metrics.set("pairs", int(postings.n_pairs))
     metrics.set("distinct_terms", len(postings))
     metrics.set("chunks", n_chunks)
     result = InvertedIndexResult(postings=postings, metrics=metrics.summary())

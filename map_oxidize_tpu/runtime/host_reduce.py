@@ -83,6 +83,10 @@ class HostCollectReduceEngine:
         self.rows_fed += n
         if n == 0:
             return
+        if out.docs64 is not None:
+            raise ValueError(
+                "pair-shaped MapOutput (docs64) fed to the scalar "
+                "HostCollectReduceEngine; pair outputs take CollectEngine")
         k64 = out.keys64 if out.keys64 is not None else join_u64(out.hi, out.lo)
         self._keys.append(k64)
         # None = implicit all-ones (the hash-only compact form): no 136MB of

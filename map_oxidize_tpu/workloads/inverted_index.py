@@ -122,21 +122,28 @@ class Postings(Mapping):
     per-term lists only on access.
     """
 
-    def __init__(self, keys_sorted: np.ndarray, docs: np.ndarray,
-                 dictionary: HashDictionary):
-        bounds = np.flatnonzero(
-            np.concatenate([[True], keys_sorted[1:] != keys_sorted[:-1]])
-        ) if keys_sorted.shape[0] else np.empty(0, np.int64)
+    def __init__(self, terms: np.ndarray, offsets: np.ndarray,
+                 docs: np.ndarray, dictionary: HashDictionary):
         #: distinct term hashes.  Sorted within each shard's block but NOT
         #: globally ascending: the sharded engine concatenates its
         #: hash-partitions shard-major, so lookups go through a lazy
         #: hash->row dict, never a binary search.
-        self._terms = keys_sorted[bounds]
+        self._terms = terms
         #: segment offsets: term i's docs are docs[off[i]:off[i+1]]
-        self._offsets = np.append(bounds, keys_sorted.shape[0])
+        self._offsets = offsets
         self._docs = docs
         self._dict = dictionary
         self._index: dict[int, int] | None = None
+
+    @classmethod
+    def from_sorted(cls, keys_sorted: np.ndarray, docs: np.ndarray,
+                    dictionary: HashDictionary) -> "Postings":
+        """Key-sorted (key, doc) rows -> CSR by boundary detection."""
+        bounds = np.flatnonzero(
+            np.concatenate([[True], keys_sorted[1:] != keys_sorted[:-1]])
+        ) if keys_sorted.shape[0] else np.empty(0, np.int64)
+        return cls(keys_sorted[bounds],
+                   np.append(bounds, keys_sorted.shape[0]), docs, dictionary)
 
     # --- array-answerable queries -----------------------------------------
 
@@ -232,7 +239,7 @@ def postings_from_sorted(keys: np.ndarray, docs: np.ndarray,
     vectorized diff, no per-row Python.  (term, doc) pairs are unique by
     construction: the mapper emits each term once per doc and docs never
     straddle chunks — newline-aligned cuts guarantee it."""
-    return Postings(keys, docs, dictionary)
+    return Postings.from_sorted(keys, docs, dictionary)
 
 
 def make_inverted_index(tokenizer: str = "ascii", use_native: bool = True):

@@ -63,6 +63,7 @@ def test_python_mapper_matches_native(tmp_path):
     nat = InvertedIndexMapper(use_native=True).map_docs(CORPUS, 0)
 
     def rows(out):
+        out.ensure_planes()  # native emits the compact (keys64, docs64) form
         k = (out.hi.astype(np.uint64) << np.uint64(32)) | out.lo
         d = (out.values[:, 0].astype(np.uint64) << np.uint64(32)) \
             | out.values[:, 1]

@@ -106,3 +106,39 @@ def test_count_u64_matches_numpy_unique():
         got_u, got_c = got
         np.testing.assert_array_equal(got_u, want_u)
         np.testing.assert_array_equal(got_c.astype(np.int64), want_c)
+
+
+def test_group_by_key_matches_sort_path():
+    """Native hash->dense-id group-by == stable-sort + boundary-scan CSR,
+    including duplicate-heavy Zipf keys, feed-order (doc) stability, a
+    single key, and the contract rejections (missing key, duplicate uniq)."""
+    from map_oxidize_tpu.native.build import group_by_key_or_none
+
+    rng = np.random.default_rng(23)
+    vocab = rng.integers(0, 2**64, size=300, dtype=np.uint64)
+    keys = vocab[rng.integers(0, 300, size=50_000)]
+    docs = np.arange(50_000, dtype=np.int64)  # feed order = doc order
+    uniq = np.unique(keys)
+
+    got = group_by_key_or_none(keys, docs, uniq)
+    if got is None:
+        pytest.skip("native library unavailable")
+    offsets, grouped = got
+    order = np.argsort(keys, kind="stable")
+    ks, ds = keys[order], docs[order]
+    bounds = np.flatnonzero(np.concatenate([[True], ks[1:] != ks[:-1]]))
+    np.testing.assert_array_equal(offsets,
+                                  np.append(bounds, ks.shape[0]))
+    np.testing.assert_array_equal(grouped, ds)
+
+    one = group_by_key_or_none(np.full(5, 7, np.uint64),
+                               np.arange(5, dtype=np.int64),
+                               np.array([7], np.uint64))
+    np.testing.assert_array_equal(one[0], [0, 5])
+    np.testing.assert_array_equal(one[1], np.arange(5))
+
+    # a fed key absent from uniq -> contract violation -> None (fallback)
+    assert group_by_key_or_none(keys, docs, uniq[:-1]) is None
+    # duplicate uniq entry -> ambiguous ids -> None
+    dup = np.sort(np.concatenate([uniq, uniq[:1]]))
+    assert group_by_key_or_none(keys, docs, dup) is None
