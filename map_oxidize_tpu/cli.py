@@ -27,7 +27,8 @@ def build_parser() -> argparse.ArgumentParser:
         description="TPU-native MapReduce (capabilities of map-oxidize, rebuilt for JAX/XLA)",
     )
     p.add_argument("workload",
-                   choices=["wordcount", "bigram", "invertedindex", "kmeans"],
+                   choices=["wordcount", "bigram", "invertedindex", "kmeans",
+                            "distinct"],
                    help="built-in workload to run")
     p.add_argument("input", help="input path: text corpus (reference: "
                                  "shakes.txt), or a .npy points file for "
@@ -71,6 +72,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "resolving winner strings (extends the collision "
                         "byte-check to every occurrence) instead of "
                         "stopping once all queried keys are found")
+    p.add_argument("--hll-precision", type=int, default=14,
+                   help="distinct: HyperLogLog precision p (2^p registers; "
+                        "rse ~1.04/sqrt(2^p))")
     p.add_argument("--kmeans-k", type=int, default=16,
                    help="k-means cluster count (init: first k points)")
     p.add_argument("--kmeans-iters", type=int, default=1,
@@ -118,6 +122,7 @@ def config_from_args(args: argparse.Namespace) -> JobConfig:
         keep_intermediates=args.keep_intermediates,
         trace_dir=args.trace_dir,
         rescan_full=args.rescan_full,
+        hll_precision=args.hll_precision,
         kmeans_k=args.kmeans_k,
         kmeans_iters=args.kmeans_iters,
     ).validate()
@@ -139,10 +144,6 @@ def main(argv: list[str] | None = None) -> int:
         _log.warning("--keep-intermediates has no effect without "
                      "--checkpoint-dir (there are no intermediates: map "
                      "outputs stay on device)")
-    if config.checkpoint_dir and args.workload == "kmeans":
-        _log.warning("--checkpoint-dir is not wired for kmeans; it runs "
-                     "without checkpointing (iterations re-stream the input)")
-
     if config.dist_coordinator:
         if args.workload not in ("wordcount", "bigram"):
             print("error: distributed mode supports wordcount/bigram",

@@ -675,6 +675,9 @@ class StreamPool:
                          start_offset: int = 0):
         return self.get().iter_file_hashes(path, chunk_bytes, start_offset)
 
+    def map_chunk_hashes(self, chunk) -> MapOutput:
+        return self.get().map_chunk_hashes(chunk)
+
     def resolve_file(self, path: str, chunk_bytes: int, hashes,
                      early_stop: bool = True):
         return self.get().resolve_file(path, chunk_bytes, hashes, early_stop)

@@ -56,6 +56,10 @@ def _run_job(config: JobConfig, workload: str):
         from map_oxidize_tpu.runtime.driver import run_inverted_index_job
 
         return run_inverted_index_job(config)
+    if workload == "distinct":
+        from map_oxidize_tpu.runtime.driver import run_distinct_job
+
+        return run_distinct_job(config)
     mode = resolve_mapper(config, workload)
     if mode == "device":
         from map_oxidize_tpu.runtime.device_map import (

@@ -509,6 +509,7 @@ def run_inverted_index_job(config: JobConfig) -> InvertedIndexResult:
         else:
             keys, docs = engine.finalize()
             postings = postings_from_sorted(keys, docs, dictionary)
+        metrics.set("grouped_finalize", csr is not None)
 
     with metrics.phase("write"):
         if config.output_path:
@@ -687,6 +688,138 @@ def run_kmeans_job(config: JobConfig, centroids: np.ndarray | None = None
     if start_iter:
         metrics.set("resumed_iters", start_iter)
     result = KMeansResult(centroids=centroids, metrics=metrics.summary())
+    if config.metrics:
+        _log.info("metrics: %s", result.metrics)
+    return result
+
+
+@dataclass
+class DistinctResult:
+    """HyperLogLog estimate plus the register state and per-phase metrics.
+    ``registers`` is the dense ``(2^p,)`` int32 array (mergeable: max with
+    another run's registers to estimate the union's cardinality)."""
+
+    estimate: float
+    registers: np.ndarray
+    metrics: dict = field(default_factory=dict)
+
+    def top_report(self, k: int) -> str:  # CLI-facing summary
+        filled = int(np.count_nonzero(self.registers))
+        return (f"distinct tokens ~ {self.estimate:,.0f}  "
+                f"(HLL p={int(np.log2(self.registers.shape[0]))}, "
+                f"{filled}/{self.registers.shape[0]} registers filled, "
+                f"rse ~{104 / np.sqrt(self.registers.shape[0]):.2f}%)")
+
+
+def run_distinct_job(config: JobConfig) -> DistinctResult:
+    """Approximate distinct-token count (HyperLogLog): max-monoid fold over
+    ``2^p`` integer-keyed registers — the most engine-friendly reduce shape
+    there is (fixed tiny key space, no dictionary, no growth), shared
+    between the single-chip fold and the sharded mesh engine unchanged.
+    See :mod:`map_oxidize_tpu.workloads.distinct` for the formulation."""
+    from map_oxidize_tpu import runtime as _rt
+    from map_oxidize_tpu.api import MaxReducer
+    from map_oxidize_tpu.workloads.distinct import (
+        DistinctMapper,
+        hll_estimate,
+    )
+
+    config.validate()
+    metrics = Metrics()
+    p = config.hll_precision
+    m = 1 << p
+    use_native = _rt.resolve_mapper(config, "distinct") == "native"
+    mapper = DistinctMapper(config.tokenizer, use_native, p)
+    engine = make_engine(config, MaxReducer(), value_shape=(),
+                         value_dtype=np.int32)
+    engine.hint_total_keys(m)
+
+    records_in = 0
+    n_chunks = 0
+
+    def _ingest(out) -> None:
+        nonlocal records_in, n_chunks
+        records_in += out.records_in
+        n_chunks += 1
+        engine.feed(out)
+
+    # --- replay checkpointed chunks (resume), if any — registers are
+    # ordinary (key, value) rows, so the standard per-chunk spill applies
+    ckpt = None
+    resume_k = 0
+    resume_off = 0
+    if config.checkpoint_dir:
+        from map_oxidize_tpu.runtime.checkpoint import CheckpointStore
+
+        ckpt = CheckpointStore(
+            config.checkpoint_dir,
+            CheckpointStore.job_meta(config, "distinct",
+                                     extra={"hll_precision": p}))
+        with metrics.phase("replay"):
+            for idx, out, next_off in ckpt.replay():
+                _ingest(out)
+                resume_k, resume_off = idx + 1, next_off
+
+    with metrics.phase("split"):
+        _, chunk_bytes = plan_chunks(config.input_path, config.chunk_bytes)
+        file_iter = mapper.map_file(config.input_path, chunk_bytes,
+                                    resume_off)
+        if file_iter is None:
+            offsets: dict[int, int] = {}
+            chunks = _track_offsets(
+                iter_chunks(config.input_path, chunk_bytes, resume_off),
+                resume_off, offsets, resume_k)
+
+    with metrics.phase("map+reduce"):
+        if file_iter is not None:
+            for i, (out, next_off) in enumerate(file_iter):
+                _ingest(out)
+                if ckpt is not None:
+                    ckpt.save(resume_k + i, out, next_off)
+        else:
+            for idx, out in run_map_phase(chunks, mapper,
+                                          config.num_map_workers,
+                                          config.max_retries):
+                _ingest(out)
+                if ckpt is not None:
+                    gidx = resume_k + idx
+                    ckpt.save(gidx, out, offsets.get(gidx, -1))
+
+    with metrics.phase("finalize"):
+        hi, lo, vals, _n = engine.finalize()
+        hi = np.asarray(hi)
+        live = hi != np.uint32(0xFFFFFFFF)  # device engines pad w/ SENTINEL
+        regs = np.zeros(m, np.int32)
+        regs[np.asarray(lo)[live].astype(np.int64)] = np.asarray(vals)[live]
+        estimate = hll_estimate(regs)
+
+    with metrics.phase("write"):
+        if config.output_path:
+            # .npy: the raw registers — the mergeable artifact (np.maximum
+            # of two runs' registers estimates the union).  Anything else:
+            # a deterministic text summary.  Atomic like every writer.
+            import os
+
+            tmp = f"{config.output_path}.tmp.{os.getpid()}"
+            if config.output_path.endswith(".npy"):
+                with open(tmp, "wb") as f:
+                    np.save(f, regs)
+            else:
+                with open(tmp, "w") as f:
+                    f.write(f"estimate\t{estimate:.1f}\n"
+                            f"precision\t{p}\n"
+                            f"registers_filled\t"
+                            f"{int(np.count_nonzero(regs))}\n")
+            os.replace(tmp, config.output_path)
+
+    if ckpt is not None:
+        ckpt.finish(config.keep_intermediates)
+
+    metrics.set("records_in", records_in)
+    metrics.set("chunks", n_chunks)
+    metrics.set("registers_filled", int(np.count_nonzero(regs)))
+    result = DistinctResult(estimate=estimate, registers=regs,
+                            metrics=metrics.summary())
     if config.metrics:
         _log.info("metrics: %s", result.metrics)
     return result

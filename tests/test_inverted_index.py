@@ -155,3 +155,25 @@ def test_sharded_collect_skewed_single_term(tmp_path):
     assert list(res.postings) == [b"hot"]
     assert res.postings[b"hot"] == sorted(res.postings[b"hot"])
     assert len(res.postings[b"hot"]) == 2000
+
+
+def test_group_by_finalize_used_and_matches_model(tmp_path):
+    """A small-vocab / many-pairs corpus passes the group-by gate (vocab <=
+    pairs/8): assert the GROUP path actually ran (grouped_finalize metric)
+    and its postings equal the independent model — the production wiring of
+    moxt_group_by_key, not just its unit test."""
+    rng = np.random.default_rng(9)
+    words = [b"t%02d" % i for i in range(40)]
+    p = tmp_path / "c.txt"
+    with open(p, "wb") as f:
+        for _ in range(800):
+            f.write(b" ".join(words[int(i)]
+                              for i in rng.integers(0, 40, 6)) + b"\n")
+    cfg = JobConfig(input_path=str(p), output_path="", backend="cpu",
+                    num_shards=1, metrics=True, chunk_bytes=4096)
+    res = run_job(cfg, "invertedindex")
+    if native is None:
+        assert res.metrics["grouped_finalize"] is False
+    else:
+        assert res.metrics["grouped_finalize"] is True
+    assert res.postings == inverted_index_model(str(p))
