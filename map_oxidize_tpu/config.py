@@ -121,9 +121,12 @@ class JobConfig:
             raise ValueError("top_k and num_map_workers must be positive")
         if self.kmeans_k <= 0 or self.kmeans_iters <= 0:
             raise ValueError("kmeans_k and kmeans_iters must be positive")
-        if not 11 <= self.hll_precision <= 18:
+        from map_oxidize_tpu.workloads.distinct import HLL_P_MIN, HLL_P_MAX
+
+        if not HLL_P_MIN <= self.hll_precision <= HLL_P_MAX:
             raise ValueError(
-                f"hll_precision must be in [11, 18], got {self.hll_precision}")
+                f"hll_precision must be in [{HLL_P_MIN}, {HLL_P_MAX}], "
+                f"got {self.hll_precision}")
         if self.dist_coordinator and (
                 self.dist_num_processes < 2 or self.dist_process_id < 0
                 or self.dist_process_id >= self.dist_num_processes):

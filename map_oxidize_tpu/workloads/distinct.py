@@ -32,6 +32,11 @@ import numpy as np
 
 from map_oxidize_tpu.api import Mapper, MapOutput, MaxReducer
 
+#: allowed precision range, shared with config.validate: below 11 the
+#: frexp-exactness argument in hll_registers needs 64-p <= 53; above 18
+#: the estimator error (~0.2%) is already far below corpus-level noise.
+HLL_P_MIN, HLL_P_MAX = 11, 18
+
 
 def hll_registers(hashes: np.ndarray, p: int) -> np.ndarray:
     """Dense ``(2^p,)`` int32 register array from raw u64 token hashes:
@@ -48,6 +53,12 @@ def hll_registers(hashes: np.ndarray, p: int) -> np.ndarray:
     # floor(log2(w)) + 1 for w > 0, so rank = (64-p) + 1 - exponent.
     _, exp = np.frexp(w)
     ranks = np.where(w == 0, 64 - p + 1, 64 - p + 1 - exp).astype(np.int64)
+    if p > 16:
+        # bincount scratch is 64 * 2^p * 8B (134MB at p=18, per concurrent
+        # chunk): bound it with the slower in-place fold instead
+        regs = np.zeros(m, np.int32)
+        np.maximum.at(regs, buckets, ranks.astype(np.int32))
+        return regs
     present = np.bincount(buckets * 64 + ranks,
                           minlength=m * 64).reshape(m, 64) > 0
     return (present * np.arange(64, dtype=np.int32)).max(axis=1)
@@ -81,11 +92,10 @@ class DistinctMapper(Mapper):
 
     def __init__(self, tokenizer: str = "ascii", use_native: bool = True,
                  p: int = 14):
-        if not 11 <= p <= 18:
-            # < 11: the frexp-exactness argument above needs 64-p <= 53;
-            # > 18: 2^18 registers already put the estimator error (~0.2%)
-            # far below corpus-level noise
-            raise ValueError(f"hll precision must be in [11, 18], got {p}")
+        if not HLL_P_MIN <= p <= HLL_P_MAX:
+            raise ValueError(
+                f"hll precision must be in [{HLL_P_MIN}, {HLL_P_MAX}], "
+                f"got {p}")
         self.tokenizer = tokenizer
         self.p = p
         self._native = None

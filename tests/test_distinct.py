@@ -144,3 +144,18 @@ def test_output_files(tmp_path):
                    "distinct")
     np.testing.assert_array_equal(np.load(tmp_path / "regs.npy"),
                                   res2.registers)
+
+
+def test_registers_high_precision_branch(rng):
+    """p > 16 uses the bounded-scratch maximum.at fold: same registers as
+    the bincount formulation computed at the same p via the model."""
+    hashes = rng.integers(0, 2**64, size=30_000, dtype=np.uint64)
+    p = 17
+    regs = hll_registers(hashes, p)
+    want = np.zeros(1 << p, np.int32)
+    for h in hashes.tolist():
+        b = h >> (64 - p)
+        w = h & ((1 << (64 - p)) - 1)
+        rank = (64 - p) + 1 if w == 0 else (64 - p) - w.bit_length() + 1
+        want[b] = max(want[b], rank)
+    np.testing.assert_array_equal(regs, want)
