@@ -18,7 +18,8 @@ hashes, no tables, no strings) or the Python tokenize+hash fallback, so
 ascii/unicode semantics and parity guarantees are inherited rather than
 re-implemented.  Register extraction is fully vectorized: a ``bincount``
 over ``bucket*64 + rank`` (ranks <= 64-p+1 < 64) and a per-row max — no
-Python per token.
+Python per token — with a bounded-scratch ``np.maximum.at`` fold above
+p=16, where the bincount scratch would reach 64 * 2^p * 8B (~134MB).
 
 Standard HLL estimator (Flajolet et al.): ``alpha_m * m^2 / sum(2^-M_j)``
 with linear-counting small-range correction; relative standard error is
