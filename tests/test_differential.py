@@ -1,0 +1,110 @@
+"""Differential fuzz: every execution route must agree exactly.
+
+For a set of seeded adversarial corpora (empty lines, CRLF, punctuation
+stuck to tokens, tokens longer than the 16-byte inline compare, token
+pairs that only differ after byte 16, a single enormous line, no trailing
+newline), run word count and bigram through every route — python mapper +
+device fold, native mapper + device fold, native + host collect
+(hash-only, winners rescan), and the 8-shard all_to_all mesh — and assert
+byte-exact agreement with the reference-semantics model
+(``workloads/reference_model.py``: tokenize per
+``/root/reference/src/main.rs:96-97``, merge per main.rs:131-134).
+
+This is the consolidated version of the per-path parity tests: one
+corpus-generation bug surface, every route, many seeds.
+"""
+
+import numpy as np
+import pytest
+
+from map_oxidize_tpu.config import JobConfig
+from map_oxidize_tpu.native.bindings import load_or_none
+from map_oxidize_tpu.runtime import run_job
+from map_oxidize_tpu.workloads.reference_model import top_k_model, wordcount_model
+
+native = load_or_none()
+
+
+def _adversarial_corpus(seed: int) -> bytes:
+    rng = np.random.default_rng(seed)
+    vocab = [
+        b"the", b"The", b"THE",                      # case-folding collisions
+        b"cat,", b"cat", b"cat.",                    # punctuation kept
+        b"x" * 15, b"x" * 16, b"x" * 17,             # inline-compare boundary
+        b"longtoken_prefix_" + b"a" * 16,            # differ after byte 16...
+        b"longtoken_prefix_" + b"b" * 16,            # ...same first 16 bytes
+        b"\xc3\xa9t\xc3\xa9",                        # multibyte UTF-8 (ascii
+        b"z",                                        #  mode treats as bytes)
+    ]
+    lines = []
+    for _ in range(int(rng.integers(100, 300))):
+        k = int(rng.integers(0, 9))
+        line = b" ".join(vocab[int(i)]
+                         for i in rng.integers(0, len(vocab), k))
+        if rng.random() < 0.1:
+            line += b"\r"          # CRLF: \r is whitespace per the reference
+        lines.append(line)
+    blob = b"\n".join(lines)
+    if seed % 2:
+        blob += b"\n"              # half the corpora lack a trailing newline
+    if seed % 3 == 0:              # one enormous single line
+        blob += b"\n" + b" ".join(
+            vocab[int(i)] for i in rng.integers(0, len(vocab), 2000))
+    return blob
+
+
+def _routes():
+    """(name, config-overrides) for every wordcount execution route that
+    runs without special hardware."""
+    routes = [
+        ("python-fold", dict(mapper="python", use_native=False)),
+        ("sharded-8", dict(num_shards=8)),
+    ]
+    if native is not None:
+        routes += [
+            ("native-fold", dict(mapper="native")),
+            ("native-collect", dict(mapper="native", reduce_mode="collect")),
+        ]
+    return routes
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 4, 5])
+def test_wordcount_all_routes_agree(tmp_path, seed):
+    blob = _adversarial_corpus(seed)
+    p = tmp_path / "c.txt"
+    p.write_bytes(blob)
+    want = wordcount_model([blob])
+    want_top = top_k_model(want, 10)
+    for name, kw in _routes():
+        base = dict(input_path=str(p), output_path="", backend="cpu",
+                    metrics=False, chunk_bytes=1024, batch_size=4096,
+                    key_capacity=1 << 14, num_shards=1)
+        base.update(kw)
+        res = run_job(JobConfig(**base), "wordcount")
+        assert dict(res.counts) == dict(want), f"route {name} seed {seed}"
+        assert res.top[:10] == want_top, f"route {name} seed {seed} top-k"
+
+
+@pytest.mark.parametrize("seed", [1, 2])
+def test_bigram_all_routes_agree(tmp_path, seed):
+    """Bigram pairs span lines within a chunk, so the model must see the
+    same chunking: use one chunk (chunk_bytes > corpus)."""
+    from collections import Counter
+
+    from map_oxidize_tpu.workloads.wordcount import tokenize
+
+    blob = _adversarial_corpus(seed)
+    p = tmp_path / "c.txt"
+    p.write_bytes(blob)
+    toks = tokenize(blob)
+    want = Counter(toks[i] + b" " + toks[i + 1]
+                   for i in range(len(toks) - 1))
+    want_top = top_k_model(want, 10)
+    for name, kw in _routes():
+        base = dict(input_path=str(p), output_path="", backend="cpu",
+                    metrics=False, chunk_bytes=1 << 22, batch_size=4096,
+                    key_capacity=1 << 16, num_shards=1)
+        base.update(kw)
+        res = run_job(JobConfig(**base), "bigram")
+        assert dict(res.counts) == dict(want), f"route {name} seed {seed}"
+        assert res.top[:10] == want_top, f"route {name} seed {seed} top-k"
