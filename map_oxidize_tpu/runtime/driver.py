@@ -543,17 +543,58 @@ class KMeansResult:
                 f"dim {self.centroids.shape[1]}")
 
 
+#: mapper='auto' picks the HBM-resident fit when the whole working set
+#: fits comfortably on one device: points (n*d*4) PLUS the (n, k)
+#: distance and one-hot intermediates (n*k*4 each) the device step
+#: materializes — i.e. 4*n*(d + 2k) bytes against this budget (v5-lite-
+#: class chips carry 16GB HBM; 2GB leaves slack for XLA's own buffers).
+#: Beyond it, the job streams — the only option at that scale.
+_KMEANS_DEVICE_FIT_BYTES = 2 << 30
+
+
+def _adopt_checkpoint_kmeans_mode(config: JobConfig,
+                                  meta_wo_mode: dict) -> str | None:
+    """Best-effort read of an existing snapshot's ``kmeans_mode``.
+
+    An ``auto`` resume must land on the mode its snapshot was cut from
+    even if the auto heuristic changed between versions — otherwise the
+    identity mismatch would silently discard training progress.  The
+    stored mode is honored only when every OTHER identity field matches
+    (a stale foreign checkpoint must not flip a fresh job's mode)."""
+    import json
+    import os
+
+    try:
+        with open(os.path.join(config.checkpoint_dir, "meta.json")) as f:
+            existing = json.load(f)
+    except (OSError, ValueError):
+        return None
+    stored = existing.get("kmeans_mode")
+    if stored not in ("device", "stream"):
+        return None
+    probe = {k: v for k, v in existing.items()
+             if k not in ("kmeans_mode", "kmeans_shards", "version")}
+    want = {k: v for k, v in meta_wo_mode.items()
+            if k not in ("kmeans_mode", "kmeans_shards", "version")}
+    return stored if probe == want else None
+
+
 def run_kmeans_job(config: JobConfig, centroids: np.ndarray | None = None
                    ) -> KMeansResult:
     """k-means (BASELINE config #5), two execution paths:
 
-    * streamed (default): ``kmeans_iters`` iterations of map (host assign +
-      per-chunk partial sums) -> device vector-sum reduce; points never sit
-      in host or device memory whole.
-    * ``mapper='device'``: HBM-resident — points transfer once and every
-      iteration is MXU work (distance matmul, one-hot matmul), sharded over
-      the mesh with one psum per iteration when more than one device is
-      visible.  Wins when iterations amortize the one-time transfer.
+    * HBM-resident (``mapper='device'``, and what ``'auto'`` resolves to
+      whenever the points fit on device): points transfer once and every
+      iteration is MXU work (distance matmul, one-hot matmul), sharded
+      over the mesh with one psum per iteration when more than one device
+      is visible.  Measured 6.5x the streamed path on the round-3
+      deployment (benchmarks/RESULTS.md) — the same auto-picks-the-
+      measured-winner policy as ``--mapper``/``--reduce-mode``.
+    * streamed (``mapper='native'``/``'python'``, or ``'auto'`` when the
+      points exceed the fit cap): ``kmeans_iters`` iterations of map (host
+      assign + per-chunk partial sums) -> device vector-sum reduce; points
+      never sit in host or device memory whole — the only option at
+      beyond-memory scale.
 
     Input: a ``.npy`` float32 ``(n, d)`` points file, memory-mapped and
     streamed by row ranges.  Initial centroids default to the first
@@ -578,7 +619,32 @@ def run_kmeans_job(config: JobConfig, centroids: np.ndarray | None = None
         centroids = np.asarray(pts[:config.kmeans_k], np.float32)
     centroids = np.asarray(centroids, np.float32)
     rows = max(1, config.chunk_bytes // (4 * d))
-    device_mode = config.mapper == "device"
+    if config.mapper == "device":
+        device_mode = True
+    elif config.mapper == "auto":
+        # whole device working set: points + the (n, k) distance/one-hot
+        # intermediates (see _KMEANS_DEVICE_FIT_BYTES)
+        device_mode = (4 * int(n) * (int(d) + 2 * config.kmeans_k)
+                       <= _KMEANS_DEVICE_FIT_BYTES)
+        if config.checkpoint_dir:
+            # an existing snapshot's mode wins over the heuristic: resume
+            # must continue the trajectory it was cut from
+            import hashlib
+
+            from map_oxidize_tpu.runtime.checkpoint import CheckpointStore
+
+            stored = _adopt_checkpoint_kmeans_mode(
+                config,
+                CheckpointStore.job_meta(config, "kmeans", extra={
+                    "kmeans_k": config.kmeans_k,
+                    "kmeans_backend": config.backend,
+                    "kmeans_init": hashlib.sha256(
+                        centroids.tobytes()).hexdigest()[:16],
+                }))
+            if stored is not None:
+                device_mode = stored == "device"
+    else:
+        device_mode = False
     n_shards = effective_num_shards(config) if device_mode else 1
 
     # --- checkpoint/resume: the iteration boundary is k-means's natural
