@@ -27,15 +27,25 @@ Layer map (mirrors SURVEY.md §1, redrawn TPU-first):
 
 __version__ = "0.1.0"
 
-from map_oxidize_tpu.api import Mapper, Reducer, SumReducer, MinReducer, MaxReducer
+from map_oxidize_tpu.api import (
+    Mapper,
+    MapOutput,
+    MaxReducer,
+    MinReducer,
+    Reducer,
+    SumReducer,
+)
 from map_oxidize_tpu.config import JobConfig
+from map_oxidize_tpu.runtime import run_job
 
 __all__ = [
     "Mapper",
+    "MapOutput",
     "Reducer",
     "SumReducer",
     "MinReducer",
     "MaxReducer",
     "JobConfig",
+    "run_job",
     "__version__",
 ]
