@@ -1,7 +1,12 @@
 #!/usr/bin/env python
 """Headline benchmark: end-to-end word-count throughput (words/sec/chip).
 
-Prints ONE JSON line: ``{"metric", "value", "unit", "vs_baseline"}``.
+Prints ONE compact JSON line as the FINAL stdout line:
+``{"metric", "value", "unit", "vs_baseline", "headline_corpus_mb",
+"workloads": {name: vs_baseline}, "detail_file"}`` — small enough to
+survive a tail-capture harness.  The full per-size/per-phase detail goes
+to ``.bench_cache/BENCH_DETAIL.json`` (round 3's artifact was unparseable
+precisely because that detail was inlined into the stdout line).
 
 ``vs_baseline`` is the speedup over the measured CPU reference baseline — a
 single-threaded host run of the reference program's exact semantics
@@ -115,7 +120,12 @@ def _run_size(run_job, JobConfig, corpus: str, warm: bool):
 
 
 def main() -> int:
-    logging.disable(logging.INFO)  # keep stdout/stderr quiet; one JSON line
+    # Keep stdout/stderr quiet so the final JSON line is the only thing a
+    # tail capture needs: silence jax's WARNING-level chatter (donation
+    # warnings alone were a multi-KB wall in round 3) and Python warnings.
+    logging.disable(logging.WARNING)
+    import warnings
+    warnings.simplefilter("ignore")
     os.makedirs(CACHE_DIR, exist_ok=True)
 
     from map_oxidize_tpu.config import JobConfig
@@ -180,17 +190,35 @@ def main() -> int:
         })
         headline = (rate, words)
 
+    detail_path = os.path.join(CACHE_DIR, "BENCH_DETAIL.json")
+    with open(detail_path, "w") as f:
+        json.dump({
+            "metric": "wordcount_words_per_sec_per_chip",
+            "value": round(headline[0], 1),
+            "unit": "words/sec",
+            "vs_baseline": round(headline[0] / base_rate, 3),
+            "headline_corpus_mb": BENCH_SIZES[-1],
+            "cpu_baseline_words_per_sec": round(base_rate, 1),
+            "per_size": per_size,
+            "workloads": workloads,
+        }, f, indent=1)
+
+    # compact scoreboard line: one ratio per workload, full detail on disk
+    wl_ratios = {}
+    for name, entry in workloads.items():
+        if isinstance(entry, dict) and "vs_baseline" in entry:
+            wl_ratios[name] = entry["vs_baseline"]
+        elif name.endswith("_error"):
+            wl_ratios[name] = entry  # surface gate failures, compactly
+    sys.stdout.flush()
     print(json.dumps({
         "metric": "wordcount_words_per_sec_per_chip",
         "value": round(headline[0], 1),
         "unit": "words/sec",
         "vs_baseline": round(headline[0] / base_rate, 3),
-        "detail": {
-            "headline_corpus_mb": BENCH_SIZES[-1],
-            "cpu_baseline_words_per_sec": round(base_rate, 1),
-            "per_size": per_size,
-            "workloads": workloads,
-        },
+        "headline_corpus_mb": BENCH_SIZES[-1],
+        "workloads": wl_ratios,
+        "detail_file": os.path.relpath(detail_path, REPO),
     }))
     return 0
 
@@ -384,9 +412,11 @@ def _bench_workloads(run_job, JobConfig) -> dict:
 
     # streamed (mapper='native' pins the streaming path; 'auto' now
     # resolves to the device fit for in-memory points) vs the HBM-resident
-    # device variant (20 iters: points transfer once, iterations are MXU
-    # matmuls that amortize it)
-    km_parity_checked = False
+    # device variant (points transfer once, iterations are MXU matmuls
+    # that amortize it).  EACH variant is parity-gated on its own 2-iter
+    # run vs 2 baseline iterations; a failing variant records its error
+    # and is skipped without discarding the other (gate-failure
+    # convention above).
     for mapper, iters, name in (
         ("native", 2, "kmeans_400k_d32_k64"),
         ("device", 20, "kmeans_device_400k_d32_k64_20iter"),
@@ -394,12 +424,16 @@ def _bench_workloads(run_job, JobConfig) -> dict:
         cfg = JobConfig(input_path=pts_path, output_path="", backend="auto",
                         metrics=True, kmeans_k=64, kmeans_iters=iters,
                         mapper=mapper)
-        r = run_job(cfg, "kmeans")  # warm
-        if not km_parity_checked:  # 2-iter run == 2 baseline iterations
-            if not np.allclose(r.centroids, km_base, rtol=1e-3, atol=1e-3):
-                out["kmeans_error"] = "kmeans parity FAILED vs NumPy baseline"
-                break
-            km_parity_checked = True
+        gate_cfg = cfg if iters == 2 else JobConfig(
+            input_path=pts_path, output_path="", backend="auto",
+            metrics=False, kmeans_k=64, kmeans_iters=2, mapper=mapper)
+        r = run_job(gate_cfg, "kmeans")  # warm + parity gate
+        if not np.allclose(r.centroids, km_base, rtol=1e-3, atol=1e-3):
+            out[f"kmeans_{mapper}_error"] = \
+                "kmeans parity FAILED vs NumPy baseline"
+            continue
+        if gate_cfg is not cfg:
+            run_job(cfg, "kmeans")  # warm the timed shape too
         r, secs = best_of(lambda: run_job(cfg, "kmeans"))
         rate = r.metrics["records_in"] / secs
         out[name] = {
