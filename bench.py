@@ -248,13 +248,14 @@ def _bench_workloads(run_job, JobConfig) -> dict:
     out = {}
 
     def best_of(fn, n=2):
-        times = []
-        r = None
+        best_r, best_t = None, None
         for _ in range(n):
             t0 = time.perf_counter()
             r = fn()
-            times.append(time.perf_counter() - t0)
-        return r, min(times)
+            dt = time.perf_counter() - t0
+            if best_t is None or dt < best_t:
+                best_r, best_t = r, dt  # result stays paired with ITS time
+        return best_r, best_t
 
     wl_mb = int(os.environ.get("MOXT_BENCH_WORKLOAD_MB", "256"))
     corpus = os.path.join(CACHE_DIR, f"zipf_{wl_mb}mb.txt")
@@ -417,32 +418,103 @@ def _bench_workloads(run_job, JobConfig) -> dict:
     # run vs 2 baseline iterations; a failing variant records its error
     # and is skipped without discarding the other (gate-failure
     # convention above).
-    for mapper, iters, name in (
-        ("native", 2, "kmeans_400k_d32_k64"),
-        ("device", 20, "kmeans_device_400k_d32_k64_20iter"),
-    ):
-        cfg = JobConfig(input_path=pts_path, output_path="", backend="auto",
-                        metrics=True, kmeans_k=64, kmeans_iters=iters,
-                        mapper=mapper)
-        gate_cfg = cfg if iters == 2 else JobConfig(
-            input_path=pts_path, output_path="", backend="auto",
-            metrics=False, kmeans_k=64, kmeans_iters=2, mapper=mapper)
-        r = run_job(gate_cfg, "kmeans")  # warm + parity gate
-        if not np.allclose(r.centroids, km_base, rtol=1e-3, atol=1e-3):
-            out[f"kmeans_{mapper}_error"] = \
-                "kmeans parity FAILED vs NumPy baseline"
-            continue
-        if gate_cfg is not cfg:
-            run_job(cfg, "kmeans")  # warm the timed shape too
+    cfg = JobConfig(input_path=pts_path, output_path="", backend="auto",
+                    metrics=True, kmeans_k=64, kmeans_iters=2,
+                    mapper="native")
+    r = run_job(cfg, "kmeans")  # warm + parity gate (2 iters == 2 baseline)
+    if not np.allclose(r.centroids, km_base, rtol=1e-3, atol=1e-3):
+        out["kmeans_stream_error"] = "kmeans parity FAILED vs NumPy baseline"
+    else:
         r, secs = best_of(lambda: run_job(cfg, "kmeans"))
         rate = r.metrics["records_in"] / secs
-        out[name] = {
+        out["kmeans_400k_d32_k64"] = {
             "best_s": round(secs, 3),
             "point_iters_per_sec": round(rate, 1),
             "vs_baseline": round(rate / km_base_rate, 3),
             "cpu_baseline_point_iters_per_sec": round(km_base_rate, 1),
             "iters": int(r.metrics["iters"]),
         }
+
+    # --- k-means, compute-bound (the MXU-dense configuration): 2M x 64
+    # points, k=256, 100 HBM-resident iterations.  The 400k/k=64 config
+    # above is transfer- and launch-dominated (round-3 verdict: ~0.01%
+    # MFU); this one runs ~13.1 TFLOP of f32(HIGHEST) matmul per timed
+    # run, so the entry reports achieved FLOP/s and MFU alongside the
+    # wall-clock ratio.  FLOPs counted: distance matmul (2ndk) + one-hot
+    # partial-sum matmul (2nkd) per iteration; argmin/one-hot/counts are
+    # O(nk) and excluded.
+    _release_heap()
+    del pts_all
+    n2, d2_, k2, iters2 = 2_000_000, 64, 256, 100
+    pts2_path = os.path.join(CACHE_DIR, "kmeans_points_2m_d64.npy")
+    if not os.path.isfile(pts2_path):
+        rng = np.random.default_rng(7)
+        c = rng.normal(0, 10, (k2, d2_)).astype(np.float32)
+        tmp = pts2_path + ".tmp.npy"
+        pts = (c[rng.integers(0, k2, n2)]
+               + rng.normal(0, 0.5, (n2, d2_)).astype(np.float32))
+        # first k rows = the true centers: the default init (first k
+        # points) then starts from well-separated, well-populated Voronoi
+        # cells, so the handful of near-tie assignment flips between the
+        # f32 oracle and the HIGHEST-precision MXU matmul (~1e-5 of
+        # points) moves each centroid by ~1/|cell| — parity holds at
+        # rtol 1e-3.  Init from arbitrary points leaves sliver cells of
+        # 2-3 points where one flipped point IS the mean.
+        pts[:k2] = c
+        np.save(tmp, pts)  # f32 by construction; astype would copy 512MB
+        os.replace(tmp, pts2_path)
+        del pts, c
+        _release_heap()
+
+    pts2 = np.asarray(np.load(pts2_path, mmap_mode="r"), np.float32)
+    km2_init = pts2[:k2].copy()
+    t0 = time.perf_counter()
+    km2_base = km2_init
+    for _ in range(2):
+        km2_base = km_cpu_iter(pts2, km2_base)
+    km2_base_rate = n2 * 2 / (time.perf_counter() - t0)
+    del pts2
+    _release_heap()
+
+    gate_cfg = JobConfig(input_path=pts2_path, output_path="",
+                         backend="auto", metrics=False, kmeans_k=k2,
+                         kmeans_iters=2, mapper="device")
+    r = run_job(gate_cfg, "kmeans")  # warm (compile both shapes) + gate
+    if not np.allclose(r.centroids, km2_base, rtol=1e-3, atol=1e-3):
+        out["kmeans_device_error"] = \
+            "kmeans device parity FAILED vs NumPy baseline"
+    else:
+        cfg = JobConfig(input_path=pts2_path, output_path="",
+                        backend="auto", metrics=True, kmeans_k=k2,
+                        kmeans_iters=iters2, mapper="device")
+        run_job(cfg, "kmeans")  # warm the timed iteration count
+        r, secs = best_of(lambda: run_job(cfg, "kmeans"))
+        rate = r.metrics["records_in"] / secs
+        entry = {
+            "best_s": round(secs, 3),
+            "point_iters_per_sec": round(rate, 1),
+            "vs_baseline": round(rate / km2_base_rate, 3),
+            "cpu_baseline_point_iters_per_sec": round(km2_base_rate, 1),
+            "iters": int(r.metrics["iters"]),
+        }
+        iter_s = r.metrics.get("time/iter_s")
+        if iter_s:  # single-device path only; the sharded fit (multi-
+            # device hosts) reports no phase split, and an MFU over full
+            # wall time would be wrong-but-plausible — omit it instead
+            flops = 4.0 * n2 * d2_ * k2 * iters2
+            # peak reference: v5e MXU bf16 ~197 TFLOP/s; the matmuls run
+            # f32 via Precision.HIGHEST (multi-pass bf16) for oracle
+            # parity, so bf16-peak MFU understates occupancy by the pass
+            # count
+            peak = float(os.environ.get("MOXT_TPU_PEAK_FLOPS", 197e12))
+            entry.update({
+                "transfer_s": r.metrics.get("time/transfer_s"),
+                "iter_s": iter_s,
+                "flops_per_sec": round(flops / iter_s, 1),
+                "mfu_pct": round(100 * flops / iter_s / peak, 2),
+                "precision": "f32(Precision.HIGHEST)",
+            })
+        out[f"kmeans_device_2m_d64_k256_{iters2}iter"] = entry
     return out
 
 

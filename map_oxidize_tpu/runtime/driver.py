@@ -547,9 +547,10 @@ class KMeansResult:
 #: fits comfortably on one device: points (n*d*4) PLUS the (n, k)
 #: distance and one-hot intermediates (n*k*4 each) the device step
 #: materializes — i.e. 4*n*(d + 2k) bytes against this budget (v5-lite-
-#: class chips carry 16GB HBM; 2GB leaves slack for XLA's own buffers).
-#: Beyond it, the job streams — the only option at that scale.
-_KMEANS_DEVICE_FIT_BYTES = 2 << 30
+#: class chips carry 16GB HBM; 8GB leaves headroom for XLA's own
+#: buffers and the fori_loop's double-buffered carries).  Beyond it, the
+#: job streams — the only option at that scale.
+_KMEANS_DEVICE_FIT_BYTES = 8 << 30
 
 
 def _adopt_checkpoint_kmeans_mode(config: JobConfig,
@@ -712,10 +713,14 @@ def run_kmeans_job(config: JobConfig, centroids: np.ndarray | None = None
 
                 from map_oxidize_tpu.runtime.engine import pick_device
 
+                timings: dict = {}
                 centroids = kmeans_fit_device(
                     np.asarray(pts, np.float32), centroids,
                     iters=remaining,
-                    device=pick_device(config.backend), on_iter=on_iter)
+                    device=pick_device(config.backend), on_iter=on_iter,
+                    timings=timings)
+                for tk, tv in timings.items():
+                    metrics.set(f"time/{tk}", round(tv, 4))
         else:
             for it in range(start_iter, config.kmeans_iters):
                 engine = make_engine(config, SumReducer(),
