@@ -140,6 +140,10 @@ def _load_lib():
         lib.moxt_sort_kd.restype = ctypes.c_int32
         lib.moxt_sort_kd.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                                      ctypes.c_int64]
+        lib.moxt_sort_u64_blocks.restype = ctypes.c_int32
+        lib.moxt_sort_u64_blocks.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64]
         lib.moxt_count_u64.restype = ctypes.c_int64
         lib.moxt_count_u64.argtypes = [ctypes.c_void_p, ctypes.c_int64,
                                        ctypes.c_void_p, ctypes.c_void_p]
@@ -554,6 +558,39 @@ def sort_kd_or_none(keys: np.ndarray, docs: np.ndarray | None):
                      "falling back to numpy")
         return False
     return True
+
+
+def sort_u64_blocks_or_none(blocks: list) -> "np.ndarray | None":
+    """Sort the concatenation of ``blocks`` (each a contiguous u64 array)
+    ascending WITHOUT materializing the concatenation first: the native
+    radix reads the blocks in place for its histogram and first scatter
+    (the first pass IS the concatenation — ~0.3 s saved at 34M rows).
+    Returns a new sorted array, or None when the native library is
+    unavailable or any block is unsuitable (caller concatenates and
+    sorts however it prefers)."""
+    try:
+        lib = _load_lib()
+    except Exception:
+        return None
+    for b in blocks:
+        if not (b.dtype == np.dtype(np.uint64) and b.ndim == 1
+                and b.flags.c_contiguous):
+            return None
+    n = int(sum(b.shape[0] for b in blocks))
+    if n == 0:
+        return np.empty(0, np.uint64)
+    live = [b for b in blocks if b.shape[0]]
+    ptrs = (ctypes.c_void_p * len(live))(*[b.ctypes.data for b in live])
+    lens = (ctypes.c_int64 * len(live))(*[b.shape[0] for b in live])
+    out = np.empty(n, np.uint64)
+    tmp = np.empty(n, np.uint64)
+    rc = lib.moxt_sort_u64_blocks(ptrs, lens, len(live), out.ctypes.data,
+                                  tmp.ctypes.data, n)
+    if rc:
+        _log.warning("native blocks radix sort could not allocate "
+                     "scratch; falling back")
+        return None
+    return out
 
 
 def count_u64_or_none(keys: np.ndarray):

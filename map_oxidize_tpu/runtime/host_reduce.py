@@ -112,57 +112,75 @@ class HostCollectReduceEngine:
             if not self._keys:
                 e = np.empty(0, np.uint64)
                 self._reduced = (e, np.empty(0, self.value_dtype))
-            else:
-                keys = np.concatenate(self._keys)
-                if all(v is None for v in self._vals):
-                    vals = None  # implicit all-ones, nothing to materialize
-                else:
-                    # the comprehension equals plain concatenation when all
-                    # blocks are explicit; mixed blocks fill in their ones
-                    vals = np.concatenate(
-                        [np.ones(k.shape[0], self.value_dtype)
-                         if v is None else v
-                         for k, v in zip(self._keys, self._vals)])
-                self._keys = self._vals = None  # free the blocks
-                if self.combine == "sum" and (
-                        vals is None or bool(np.all(vals == 1))):
-                    # hash-only count path: every row weighs 1, so counts
-                    # are run lengths.  Two native formulations, winner by
-                    # key-space shape (measured, 34M keys, benchmarks/
-                    # RESULTS.md round 3): the fused MSD+in-cache-LSD
-                    # unique+count saves ~3x DRAM traffic and wins on
-                    # mostly-UNIQUE keys (4.6 vs 6.4s); duplicate-heavy
-                    # keys (Zipf bigrams, 5:1) invert it (2.9 vs 2.3s) —
-                    # equal-key runs give the plain LSD scatter write
-                    # locality the bucket partition cannot exploit.  A 64k
-                    # stride sample picks the side; np.unique stays the
-                    # no-native fallback.
-                    from map_oxidize_tpu.native.build import (
-                        count_u64_or_none,
-                        sort_kd_or_none,
-                    )
+            elif self.combine == "sum" and all(
+                    v is None or bool(np.all(np.asarray(v) == 1))
+                    for v in self._vals):
+                # hash-only count path: every row weighs 1, so counts
+                # are run lengths.  Two native formulations, winner by
+                # key-space shape (measured, 34M keys, benchmarks/
+                # RESULTS.md round 3): the fused MSD+in-cache-LSD
+                # unique+count saves ~3x DRAM traffic and wins on
+                # mostly-UNIQUE keys (4.6 vs 6.4s); duplicate-heavy
+                # keys (Zipf bigrams, 5:1) invert it (2.9 vs 2.3s) —
+                # equal-key runs give the plain LSD scatter write
+                # locality the bucket partition cannot exploit.  A 64k
+                # stride sample (across blocks) picks the side; the
+                # duplicate-heavy sort consumes the staged blocks IN
+                # PLACE (sort_u64_blocks: its first radix pass is the
+                # concatenation); np.unique stays the no-native fallback.
+                from map_oxidize_tpu.native.build import (
+                    count_u64_or_none,
+                    sort_kd_or_none,
+                    sort_u64_blocks_or_none,
+                )
 
-                    uniq = counts = None
-                    n_rows = int(keys.shape[0])
-                    if self.config.use_native and n_rows > (1 << 20):
-                        samp = keys[::max(n_rows // 65536, 1)]
-                        if np.unique(samp).shape[0] >= 0.98 * samp.shape[0]:
-                            uc = count_u64_or_none(keys)
-                            if uc is not None:
-                                uniq, counts = uc
-                    if uniq is None and self.config.use_native \
-                            and sort_kd_or_none(keys, None):
+                blocks = self._keys
+                uniq = counts = None
+                keys = None
+                n_rows = int(sum(b.shape[0] for b in blocks))
+                if self.config.use_native and n_rows > (1 << 20):
+                    stride = max(n_rows // 65536, 1)
+                    samp = np.concatenate([b[::stride] for b in blocks])
+                    if np.unique(samp).shape[0] >= 0.98 * samp.shape[0]:
+                        keys = np.concatenate(blocks)
+                        self._keys = self._vals = blocks = None
+                        uc = count_u64_or_none(keys)
+                        if uc is not None:
+                            uniq, counts = uc
+                if uniq is None and blocks is not None \
+                        and self.config.use_native:
+                    sorted_keys = sort_u64_blocks_or_none(blocks)
+                    if sorted_keys is not None:
+                        self._keys = self._vals = blocks = None
+                        bounds = self._segment_bounds(sorted_keys)
+                        counts = np.diff(
+                            np.append(bounds, sorted_keys.shape[0]))
+                        uniq = sorted_keys[bounds]
+                if uniq is None:
+                    if keys is None:
+                        keys = np.concatenate(blocks)
+                    self._keys = self._vals = blocks = None
+                    if self.config.use_native and sort_kd_or_none(keys,
+                                                                  None):
                         bounds = self._segment_bounds(keys)
                         counts = np.diff(np.append(bounds, keys.shape[0]))
                         uniq = keys[bounds]
-                    if uniq is None:
-                        uniq, counts = np.unique(keys, return_counts=True)
-                    self._reduced = (uniq,
-                                     counts.astype(self.value_dtype,
-                                                   copy=False))
-                    return self._reduced
-                if vals is None:  # implicit ones outside the sum fast path
-                    vals = np.ones(keys.shape[0], self.value_dtype)
+                    else:
+                        uniq, counts = np.unique(keys,
+                                                 return_counts=True)
+                self._reduced = (uniq,
+                                 counts.astype(self.value_dtype,
+                                               copy=False))
+                return self._reduced
+            else:
+                keys = np.concatenate(self._keys)
+                # the comprehension equals plain concatenation when all
+                # blocks are explicit; mixed blocks fill in their ones
+                vals = np.concatenate(
+                    [np.ones(k.shape[0], self.value_dtype)
+                     if v is None else v
+                     for k, v in zip(self._keys, self._vals)])
+                self._keys = self._vals = None  # free the blocks
                 order = np.argsort(keys, kind="stable")
                 keys = keys[order]
                 vals = vals[order]
