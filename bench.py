@@ -274,15 +274,12 @@ def _bench_workloads(run_job, JobConfig) -> dict:
     # best-of-2 on the BASELINE too: the ±15% session drift
     # (benchmarks/RESULTS.md) hits both sides of the ratio, and a one-shot
     # baseline reading that lands slow inflates every bigram ratio
-    bigram_base_s = None
-    for _ in range(2):
-        t0 = time.perf_counter()
+    def _bigram_baseline():
         toks = tokenize(slice_bytes)
-        bigram_base = Counter(toks[i] + b" " + toks[i + 1]
-                              for i in range(len(toks) - 1))
-        dt = time.perf_counter() - t0
-        bigram_base_s = dt if bigram_base_s is None else min(
-            bigram_base_s, dt)
+        return toks, Counter(toks[i] + b" " + toks[i + 1]
+                             for i in range(len(toks) - 1))
+
+    (toks, bigram_base), bigram_base_s = best_of(_bigram_baseline, n=2)
     bigram_base_rate = max(len(toks) - 1, 1) / bigram_base_s
     # parity gate on the slice (one chunk there, so model chunking matches).
     # num_shards=1: bigram auto-routes to the host collect-reduce engine,

@@ -181,8 +181,9 @@ def kmeans_fit_device(points, centroids, iters: int = 1, device=None,
     for i in range(iters):
         c = _kmeans_step(c, p_dev, k)
         on_iter(i + 1, np.asarray(c))
-    if timings is not None:
-        timings["iter_s"] = time.perf_counter() - t0
+    # no iter_s here: this loop interleaves per-iteration readback and the
+    # caller's snapshot I/O, so it is NOT the compute-bound region the
+    # docstring promises — an MFU computed over it would be wrong
     return np.asarray(c)
 
 
