@@ -145,27 +145,29 @@ def main(argv: list[str] | None = None) -> int:
                      "--checkpoint-dir (there are no intermediates: map "
                      "outputs stay on device)")
     if config.dist_coordinator:
-        if args.workload not in ("wordcount", "bigram"):
-            print("error: distributed mode supports wordcount/bigram",
-                  file=sys.stderr)
+        if args.workload == "kmeans":
+            print("error: distributed mode supports wordcount/bigram/"
+                  "invertedindex/distinct (kmeans scales multi-chip via "
+                  "--num-shards on one controller)", file=sys.stderr)
             return 2
-        _log.info("distributed mode reports hash-keyed top-k only; no "
-                  "output file is written (key strings live in per-process "
-                  "dictionaries)")
-        if config.checkpoint_dir:
-            _log.warning("--checkpoint-dir is not wired for distributed "
-                         "mode; running without")
         from map_oxidize_tpu.parallel.distributed import (
             init_distributed,
-            run_distributed_wordcount,
+            run_distributed_job,
         )
 
         init_distributed(config.dist_coordinator,
                          config.dist_num_processes, config.dist_process_id)
-        counts, top = run_distributed_wordcount(config, args.workload)
-        print(f"Top {config.top_k} keys ({len(counts)} distinct):")
-        for h, c in top:
-            print(f"{h:#018x}: {c}")
+        r = run_distributed_job(config, args.workload)
+        if args.workload == "distinct":
+            print(f"distinct tokens ~ {r.estimate:,.0f} "
+                  f"({config.dist_num_processes} processes)")
+            return 0
+        unit = "docs" if args.workload == "invertedindex" else ""
+        print(f"Top {config.top_k} keys ({r.n_keys} distinct):")
+        for h, word, c in r.top:
+            name = word.decode("utf-8", "replace") if word is not None \
+                else f"{h:#018x}"
+            print(f"{name}: {c}{' ' + unit if unit else ''}")
         return 0
 
     from map_oxidize_tpu.runtime import run_job

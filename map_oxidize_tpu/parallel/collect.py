@@ -136,6 +136,14 @@ class ShardedCollectEngine:
             out_specs=(row2,) * 4,
         ))
 
+    # host-read hooks: the multi-process subclass must replicate sharded
+    # values before np.asarray can address them (DistributedCollectEngine)
+    def _cursor_max(self) -> int:
+        return int(np.max(np.asarray(self._cursor)))
+
+    def _fetch(self, x) -> np.ndarray:
+        return np.asarray(x)
+
     def _ensure_room(self) -> None:
         """Grow the receive buffer so one more exchanged block always fits
         below R (dynamic_update_slice would clamp-and-overwrite otherwise).
@@ -155,7 +163,7 @@ class ShardedCollectEngine:
         if needed <= self.R:
             return
         # refresh the bound from the device before paying a growth
-        self._cursor_ub = int(np.max(np.asarray(self._cursor)))
+        self._cursor_ub = self._cursor_max()
         needed = self._cursor_ub + self.block
         if needed <= self.R:
             return
@@ -227,7 +235,7 @@ class ShardedCollectEngine:
                     "or raise it")
         if self._buf is None:
             return np.empty(0, np.uint64), np.empty(0, np.int64)
-        s_hi, s_lo, s_dhi, s_dlo = [np.asarray(x)
+        s_hi, s_lo, s_dhi, s_dlo = [self._fetch(x)
                                     for x in self._sort(*self._buf)]
         keys_parts, docs_parts = [], []
         sent = np.uint32(SENTINEL)
