@@ -145,6 +145,9 @@ def main(argv: list[str] | None = None) -> int:
                      "--checkpoint-dir (there are no intermediates: map "
                      "outputs stay on device)")
     if config.dist_coordinator:
+        if config.output_path:
+            _log.info("distributed mode writes no output file (full key "
+                      "strings stay per-process); --output is ignored")
         if args.workload == "kmeans":
             print("error: distributed mode supports wordcount/bigram/"
                   "invertedindex/distinct (kmeans scales multi-chip via "

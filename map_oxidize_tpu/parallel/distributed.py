@@ -206,6 +206,7 @@ class DistributedCollectEngine(ShardedCollectEngineBase):
         if self.feed_batch % self.n_proc:
             raise ValueError("feed_batch must divide by process count")
         self.local_rows = self.feed_batch // self.n_proc
+        self._sharding = self._row_spec  # _any_remaining's flag spec
         self._rep = jax.jit(lambda x: x,
                             out_shardings=replicated(self.mesh))
         self._flag_sum = _make_flag_sum(self.mesh)
@@ -283,8 +284,7 @@ def _any_remaining(engine, i_have_rows: bool) -> bool:
     S = engine.S
     local = np.full(S // engine.n_proc, 1 if i_have_rows else 0, np.int32)
     flags = jax.make_array_from_process_local_data(
-        engine._sharding if hasattr(engine, "_sharding")
-        else engine._row_spec, local, (S,))
+        engine._sharding, local, (S,))
     return int(np.asarray(engine._flag_sum(flags))) > 0
 
 
@@ -426,10 +426,10 @@ def run_distributed_job(config: JobConfig, workload: str
     # job identity (a resume under a different process count would replay
     # chunks this process no longer owns)
     ckpt = None
-    skip = 0
     staged_outs: list = []
     staged = 0
     records = 0
+    resumed = 0
     if config.checkpoint_dir:
         import os
 
@@ -441,20 +441,40 @@ def run_distributed_job(config: JobConfig, workload: str
                 "dist_processes": P_,
                 "dist_process_id": engine.proc,
             }))
-        for _idx, out, _off in ckpt.replay():
-            out.ensure_planes()
-            dictionary.update(out.dictionary)
-            staged_outs.append(out)
-            staged += len(out)
-            records += out.records_in
-            skip += 1
-        if skip:
-            _log.info("process %d resumed %d checkpointed chunks",
-                      engine.proc, skip)
-    resumed = skip
-
-    chunks = _local_chunks(config, engine.proc, P_, doc_mode, skip)
     vals_dtype = np.uint32 if doc_mode else np.int32
+
+    def _produce():
+        """Yield this process's MapOutputs: the checkpointed prefix first
+        (LAZILY — a large resumed prefix streams through the lockstep loop
+        instead of sitting whole in host RAM), then freshly mapped chunks,
+        spilled as they are produced."""
+        nonlocal resumed
+        replayed = 0
+        if ckpt is not None:
+            for _idx, out, _off in ckpt.replay():
+                out.ensure_planes()
+                replayed += 1
+                yield out
+            resumed = replayed
+            if replayed:
+                _log.info("process %d resumed %d checkpointed chunks",
+                          engine.proc, replayed)
+        # the chunk generator starts only now: replay() may stop short of
+        # its saved prefix on a corrupt tail, and those ranges must re-map
+        save_at = replayed
+        for _idx, chunk, base in _local_chunks(config, engine.proc, P_,
+                                               doc_mode, replayed):
+            if doc_mode:
+                out = mapper.map_docs(chunk, base)
+            else:
+                out = mapper.map_chunk(bytes(chunk))
+            out.ensure_planes()  # no-op except for compact keys64 outputs
+            if ckpt is not None:
+                ckpt.save(save_at, out, base + len(chunk))
+                save_at += 1
+            yield out
+
+    source = _produce()
 
     def _pop_block():
         nonlocal staged
@@ -480,18 +500,10 @@ def run_distributed_job(config: JobConfig, workload: str
     while True:
         while not exhausted and staged < engine.local_rows:
             try:
-                idx, chunk, base = next(chunks)
+                out = next(source)
             except StopIteration:
                 exhausted = True
                 break
-            if doc_mode:
-                out = mapper.map_docs(chunk, base)
-            else:
-                out = mapper.map_chunk(bytes(chunk))
-            out.ensure_planes()  # no-op except for compact keys64 outputs
-            if ckpt is not None:
-                ckpt.save(skip, out, base + len(chunk))
-                skip += 1
             dictionary.update(out.dictionary)
             staged_outs.append(out)
             staged += len(out)
@@ -568,9 +580,7 @@ def _run_distributed_distinct(config: JobConfig) -> DistributedResult:
 
     from jax.experimental import multihost_utils
 
-    from map_oxidize_tpu.workloads.distinct import hll_estimate
-
-    from map_oxidize_tpu.workloads.distinct import DistinctMapper
+    from map_oxidize_tpu.workloads.distinct import DistinctMapper, hll_estimate
 
     proc = jax.process_index()
     n_proc = jax.process_count()

@@ -49,9 +49,18 @@ class HostCollectReduceEngine:
     segment-``reduceat`` at finalize.
 
     Scalar values only (the wide-key workloads are count-shaped); vector
-    values keep the fold engine.  ``max_rows`` bounds host memory the same
-    way CollectEngine bounds HBM.
+    values keep the fold engine.  ``max_rows`` bounds RESIDENT host
+    memory: a hash-only count job that crosses it switches to an
+    external-memory partition (top-bits disk buckets, reduced bucket-by-
+    bucket at finalize — see ``_begin_spill``) instead of aborting; only
+    jobs with explicit non-one values still abort at the cap.
     """
+
+    #: disk-bucket count for the beyond-RAM path: top 8 key bits.  Random
+    #: hash keys split ~uniformly, so each bucket holds ~rows/256 —
+    #: crossing a 2GB cap leaves ~8MB buckets, each reduced entirely in
+    #: cache-resident memory at finalize.
+    SPILL_BUCKETS_BITS = 8
 
     def __init__(self, config: JobConfig, reducer: Reducer,
                  value_shape: tuple = (), value_dtype=np.int32,
@@ -69,6 +78,16 @@ class HostCollectReduceEngine:
         self._keys: list[np.ndarray] = []   # u64 blocks
         self._vals: list[np.ndarray] = []
         self._reduced: tuple | None = None
+        # external-memory spill state (hash-only count jobs past max_rows)
+        self._staged_rows = 0
+        self.peak_staged_rows = 0           # observability + test oracle
+        self._spill_dir = None              # tempfile.TemporaryDirectory
+        self._spill_files: list = []
+        self.spilled_rows = 0
+
+    @property
+    def spilled(self) -> bool:
+        return self._spill_dir is not None or self.spilled_rows > 0
 
     # the capacity-hint surface is a no-op: there is no device accumulator
     # to size, and distinct keys are discovered by the one final sort
@@ -88,18 +107,75 @@ class HostCollectReduceEngine:
                 "pair-shaped MapOutput (docs64) fed to the scalar "
                 "HostCollectReduceEngine; pair outputs take CollectEngine")
         k64 = out.keys64 if out.keys64 is not None else join_u64(out.hi, out.lo)
+        if self._spill_dir is not None:
+            if out.values is not None and not bool(
+                    np.all(np.asarray(out.values) == 1)):
+                raise RuntimeError(
+                    "explicit values fed after the engine switched to the "
+                    "hash-only spill path")
+            self._spill_block(k64)
+            return
         self._keys.append(k64)
         # None = implicit all-ones (the hash-only compact form): no 136MB of
         # ones to allocate, concatenate, and re-scan at finalize
         self._vals.append(None if out.values is None
                           else np.asarray(out.values, self.value_dtype))
+        self._staged_rows += n
+        self.peak_staged_rows = max(self.peak_staged_rows, self._staged_rows)
         if self.rows_fed > self.max_rows:
-            raise RuntimeError(
-                f"HostCollectReduceEngine exceeded max_rows={self.max_rows}; "
-                "shard the job or raise the limit")
+            if self.combine == "sum" and all(v is None or bool(
+                    np.all(np.asarray(v) == 1)) for v in self._vals):
+                self._begin_spill()
+            else:
+                raise RuntimeError(
+                    f"HostCollectReduceEngine exceeded max_rows="
+                    f"{self.max_rows} with explicit values; shard the job "
+                    "or raise the limit (the beyond-RAM spill covers "
+                    "hash-only count jobs)")
 
     def flush(self) -> None:  # feed is already host-resident
         pass
+
+    # --- external-memory partition (beyond-RAM count jobs) ---------------
+
+    def _begin_spill(self) -> None:
+        """Switch to disk-bucket staging: partition every staged block by
+        the top ``SPILL_BUCKETS_BITS`` key bits into per-bucket files, then
+        route all further feeds the same way.  Resident memory drops to the
+        per-feed block plus OS write buffers; finalize reduces one ~1/256th
+        bucket at a time (buckets are top-bit ranges, so bucket-by-bucket
+        output concatenates into the globally ascending order every caller
+        already expects)."""
+        import tempfile
+
+        B = 1 << self.SPILL_BUCKETS_BITS
+        self._spill_dir = tempfile.TemporaryDirectory(prefix="moxt_spill_")
+        self._spill_files = [None] * B
+        _log.info(
+            "host collect crossed max_rows=%d; spilling to %d disk buckets "
+            "under %s", self.max_rows, B, self._spill_dir.name)
+        blocks, self._keys, self._vals = self._keys, None, None
+        self._staged_rows = 0
+        for k64 in blocks:
+            self._spill_block(k64)
+
+    def _spill_block(self, k64: np.ndarray) -> None:
+        import os
+
+        bits = self.SPILL_BUCKETS_BITS
+        bucket = (k64 >> np.uint64(64 - bits)).astype(np.int64)
+        order = np.argsort(bucket, kind="stable")
+        sk = k64[order]
+        counts = np.bincount(bucket, minlength=1 << bits)
+        offs = np.concatenate([[0], np.cumsum(counts)])
+        for i in np.flatnonzero(counts):
+            f = self._spill_files[i]
+            if f is None:
+                f = open(os.path.join(self._spill_dir.name,
+                                      f"bucket_{i:03d}.u64"), "wb")
+                self._spill_files[i] = f
+            f.write(sk[offs[i]:offs[i + 1]].tobytes())
+        self.spilled_rows += int(k64.shape[0])
 
     @staticmethod
     def _segment_bounds(keys_sorted: np.ndarray) -> np.ndarray:
@@ -107,70 +183,104 @@ class HostCollectReduceEngine:
         return np.flatnonzero(np.concatenate(
             [[True], keys_sorted[1:] != keys_sorted[:-1]]))
 
+    def _count_unique(self, blocks: "list[np.ndarray]") -> tuple:
+        """(uniq ascending, counts) of the concatenation of u64 ``blocks``
+        where every row weighs 1 — counts are run lengths.  Two native
+        formulations, winner by key-space shape (measured, 34M keys,
+        benchmarks/RESULTS.md round 3): the fused MSD+in-cache-LSD
+        unique+count saves ~3x DRAM traffic and wins on mostly-UNIQUE
+        keys (4.6 vs 6.4s); duplicate-heavy keys (Zipf bigrams, 5:1)
+        invert it (2.9 vs 2.3s) — equal-key runs give the plain LSD
+        scatter write locality the bucket partition cannot exploit.  A
+        64k stride sample (across blocks) picks the side; the
+        duplicate-heavy sort consumes the blocks IN PLACE
+        (sort_u64_blocks: its first radix pass is the concatenation);
+        np.unique stays the no-native fallback.  ``blocks`` is consumed
+        (the caller must drop its own references)."""
+        from map_oxidize_tpu.native.build import (
+            count_u64_or_none,
+            sort_kd_or_none,
+            sort_u64_blocks_or_none,
+        )
+
+        uniq = counts = None
+        keys = None
+        n_rows = int(sum(b.shape[0] for b in blocks))
+        if self.config.use_native and n_rows > (1 << 20):
+            stride = max(n_rows // 65536, 1)
+            samp = np.concatenate([b[::stride] for b in blocks])
+            if np.unique(samp).shape[0] >= 0.98 * samp.shape[0]:
+                keys = np.concatenate(blocks)
+                blocks = None
+                uc = count_u64_or_none(keys)
+                if uc is not None:
+                    uniq, counts = uc
+        if uniq is None and blocks is not None and self.config.use_native:
+            sorted_keys = sort_u64_blocks_or_none(blocks)
+            if sorted_keys is not None:
+                blocks = None
+                bounds = self._segment_bounds(sorted_keys)
+                counts = np.diff(np.append(bounds, sorted_keys.shape[0]))
+                uniq = sorted_keys[bounds]
+        if uniq is None:
+            if keys is None:
+                keys = np.concatenate(blocks)
+                blocks = None
+            if self.config.use_native and sort_kd_or_none(keys, None):
+                bounds = self._segment_bounds(keys)
+                counts = np.diff(np.append(bounds, keys.shape[0]))
+                uniq = keys[bounds]
+            else:
+                uniq, counts = np.unique(keys, return_counts=True)
+        if counts.shape[0] and int(counts.max()) > np.iinfo(
+                self.value_dtype).max:
+            # beyond-RAM jobs can push one hot key past int32: keep the
+            # wide dtype (correct counts) rather than silently wrapping
+            _log.info("a key's count exceeds %s; returning int64 counts",
+                      self.value_dtype)
+            return uniq, counts.astype(np.int64, copy=False)
+        return uniq, counts.astype(self.value_dtype, copy=False)
+
+    def _reduce_spilled(self) -> tuple:
+        """Bucket-by-bucket reduce of the disk partition: bucket i holds
+        exactly the keys with top bits == i, so per-bucket (uniq, counts)
+        concatenate into the same globally ascending result the in-RAM
+        path produces — no cross-bucket merge exists to do."""
+        import os
+
+        uniq_parts: list = []
+        count_parts: list = []
+        for i, f in enumerate(self._spill_files):
+            if f is None:
+                continue
+            f.flush()
+            f.close()
+            path = os.path.join(self._spill_dir.name, f"bucket_{i:03d}.u64")
+            arr = np.fromfile(path, np.uint64)
+            os.unlink(path)  # free disk as we go; peak disk = rows once
+            u, c = self._count_unique([arr])
+            uniq_parts.append(u)
+            count_parts.append(c)
+        self._spill_files = []
+        self._spill_dir.cleanup()
+        self._spill_dir = None  # spilled stays observable via spilled_rows
+        if not uniq_parts:
+            return (np.empty(0, np.uint64), np.empty(0, self.value_dtype))
+        return (np.concatenate(uniq_parts), np.concatenate(count_parts))
+
     def _reduce(self) -> tuple:
         if self._reduced is None:
-            if not self._keys:
+            if self.spilled_rows:
+                self._reduced = self._reduce_spilled()
+            elif not self._keys:
                 e = np.empty(0, np.uint64)
                 self._reduced = (e, np.empty(0, self.value_dtype))
             elif self.combine == "sum" and all(
                     v is None or bool(np.all(np.asarray(v) == 1))
                     for v in self._vals):
-                # hash-only count path: every row weighs 1, so counts
-                # are run lengths.  Two native formulations, winner by
-                # key-space shape (measured, 34M keys, benchmarks/
-                # RESULTS.md round 3): the fused MSD+in-cache-LSD
-                # unique+count saves ~3x DRAM traffic and wins on
-                # mostly-UNIQUE keys (4.6 vs 6.4s); duplicate-heavy
-                # keys (Zipf bigrams, 5:1) invert it (2.9 vs 2.3s) —
-                # equal-key runs give the plain LSD scatter write
-                # locality the bucket partition cannot exploit.  A 64k
-                # stride sample (across blocks) picks the side; the
-                # duplicate-heavy sort consumes the staged blocks IN
-                # PLACE (sort_u64_blocks: its first radix pass is the
-                # concatenation); np.unique stays the no-native fallback.
-                from map_oxidize_tpu.native.build import (
-                    count_u64_or_none,
-                    sort_kd_or_none,
-                    sort_u64_blocks_or_none,
-                )
-
                 blocks = self._keys
-                uniq = counts = None
-                keys = None
-                n_rows = int(sum(b.shape[0] for b in blocks))
-                if self.config.use_native and n_rows > (1 << 20):
-                    stride = max(n_rows // 65536, 1)
-                    samp = np.concatenate([b[::stride] for b in blocks])
-                    if np.unique(samp).shape[0] >= 0.98 * samp.shape[0]:
-                        keys = np.concatenate(blocks)
-                        self._keys = self._vals = blocks = None
-                        uc = count_u64_or_none(keys)
-                        if uc is not None:
-                            uniq, counts = uc
-                if uniq is None and blocks is not None \
-                        and self.config.use_native:
-                    sorted_keys = sort_u64_blocks_or_none(blocks)
-                    if sorted_keys is not None:
-                        self._keys = self._vals = blocks = None
-                        bounds = self._segment_bounds(sorted_keys)
-                        counts = np.diff(
-                            np.append(bounds, sorted_keys.shape[0]))
-                        uniq = sorted_keys[bounds]
-                if uniq is None:
-                    if keys is None:
-                        keys = np.concatenate(blocks)
-                    self._keys = self._vals = blocks = None
-                    if self.config.use_native and sort_kd_or_none(keys,
-                                                                  None):
-                        bounds = self._segment_bounds(keys)
-                        counts = np.diff(np.append(bounds, keys.shape[0]))
-                        uniq = keys[bounds]
-                    else:
-                        uniq, counts = np.unique(keys,
-                                                 return_counts=True)
-                self._reduced = (uniq,
-                                 counts.astype(self.value_dtype,
-                                               copy=False))
+                self._keys = self._vals = None  # consumed by _count_unique
+                self._reduced = self._count_unique(blocks)
                 return self._reduced
             else:
                 keys = np.concatenate(self._keys)
