@@ -87,7 +87,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dist-process-id", type=int, default=-1,
                    help="multi-host: this process's id (0-based)")
     p.add_argument("--checkpoint-dir", default=None,
-                   help="directory for resumable map-output checkpoints")
+                   help="directory for resumable map-output checkpoints "
+                        "(kmeans: per-iteration snapshots; a SUCCESSFUL "
+                        "run deletes its snapshot, so continuing training "
+                        "past a completed run needs --keep-intermediates "
+                        "on the earlier run)")
     p.add_argument("--trace-dir", default=None,
                    help="capture a jax.profiler trace of the run into this "
                         "directory (TensorBoard-compatible)")

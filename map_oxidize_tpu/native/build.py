@@ -486,6 +486,16 @@ class NativeStream:
                     if (early_stop
                             and self._lib.moxt_resolve_remaining(self._st)
                             == 0):
+                        if off < size:
+                            # the 64-bit collision byte-check covered only
+                            # the scanned prefix — say exactly how much, so
+                            # the guarantee's scope is visible (advisor r3;
+                            # --rescan-full restores the full-corpus check)
+                            _log.info(
+                                "resolve early-stop at %d/%d bytes "
+                                "(%.1f%%); collision byte-check covers the "
+                                "scanned prefix only", off, size,
+                                100.0 * off / size)
                         break
             finally:
                 self._lib.moxt_file_close(f)

@@ -655,6 +655,10 @@ def run_kmeans_job(config: JobConfig, centroids: np.ndarray | None = None
     # the identity: a snapshot at iteration i resumes any same-job run
     # asking for >= i iterations ("continue training"); k, mode, and shard
     # count ARE identity (they change the float accumulation order).
+    # NOTE a successful run DELETES its snapshot (same cleanup contract as
+    # every workload; tested): continue-training past a COMPLETED run
+    # requires --keep-intermediates on the earlier run.  Only interrupted
+    # runs and zero-work reads keep the snapshot implicitly.
     store = None
     start_iter = 0
     if config.checkpoint_dir:
