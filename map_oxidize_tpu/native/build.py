@@ -103,6 +103,10 @@ def _load_lib():
         lib.moxt_map_range.restype = ctypes.c_int64
         lib.moxt_map_range.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                                        ctypes.c_int64, ctypes.c_int64]
+        lib.moxt_map_docs_ex.restype = ctypes.c_int32
+        lib.moxt_map_docs_ex.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                         ctypes.c_int64, ctypes.c_int64,
+                                         ctypes.c_int32]
         lib.moxt_map_docs.restype = ctypes.c_int32
         lib.moxt_map_docs.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                                       ctypes.c_int64, ctypes.c_int64]

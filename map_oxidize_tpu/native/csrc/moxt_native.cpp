@@ -348,6 +348,12 @@ struct UnicodeTables {
 struct MoxtState {
   int32_t ngram = 1;
   Table chunk;        // per-chunk (hash -> count); epoch-cleared
+  Table doc;          // per-DOC distinct set (docs mode): starts tiny so
+                      // the per-token probe stays L1-resident — a ~12-term
+                      // doc probed through the 3MB chunk table cost ~26
+                      // ns/token of cache misses (round-4 decomposition,
+                      // benchmarks/RESULTS.md); grows only when one doc
+                      // exceeds half its capacity
   Arena chunk_arena;  // key bytes for the current chunk (reset per chunk)
   Table dict;         // persistent hash -> bytes across chunks
   Arena dict_arena;   // persistent key bytes (append-only, insert order)
@@ -851,6 +857,7 @@ MoxtState* moxt_new(int32_t ngram) {
   MoxtState* st = new MoxtState();
   st->ngram = ngram;
   st->chunk.init(1 << 16);
+  st->doc.init(1 << 8);
   st->dict.init(1 << 16);
   return st;
 }
@@ -858,6 +865,7 @@ MoxtState* moxt_new(int32_t ngram) {
 void moxt_free(MoxtState* st) {
   if (!st) return;
   st->chunk.destroy();
+  st->doc.destroy();
   st->dict.destroy();
   st->chunk_arena.destroy();
   st->dict_arena.destroy();
@@ -1023,8 +1031,23 @@ int64_t moxt_chunk_tokens(MoxtState* st) { return st->n_tokens; }
 // this doc".  Dictionary entries are inserted inline (the chunk table only
 // holds the current doc).  BASELINE.json config #4; generalizes the
 // reference's per-chunk HashMap (main.rs:94-101) to per-document key sets.
+// flags for moxt_map_docs_ex: which per-fresh-pair stores to run.  The
+// default (both) is the production path; the reduced forms exist to
+// DECOMPOSE the doc-mode scan cost (benchmarks/RESULTS.md round 4) and to
+// serve a future hash-only index mode (strings recovered by rescan).
+static const int32_t kDocsPairs = 1;
+static const int32_t kDocsDict = 2;
+
+int32_t moxt_map_docs_ex(MoxtState* st, const uint8_t* data, int64_t len,
+                         int64_t base_doc, int32_t flags);
+
 int32_t moxt_map_docs(MoxtState* st, const uint8_t* data, int64_t len,
                       int64_t base_doc) {
+  return moxt_map_docs_ex(st, data, len, base_doc, kDocsPairs | kDocsDict);
+}
+
+int32_t moxt_map_docs_ex(MoxtState* st, const uint8_t* data, int64_t len,
+                         int64_t base_doc, int32_t flags) {
   if (!st || st->error == 2) return 2;
   // unicode transform would shift byte offsets and break doc identity; the
   // driver keeps unicode inverted-index on the Python path
@@ -1050,7 +1073,7 @@ int32_t moxt_map_docs(MoxtState* st, const uint8_t* data, int64_t len,
   int64_t pos = 0;
   int64_t line_start = 0;   // in-chunk offset of the current doc's first byte
   int64_t scanned = 0;      // newline search frontier
-  st->chunk.new_epoch();
+  st->doc.new_epoch();
   while (true) {
     int64_t start = next_clear(ws, pos);
     if (start >= len) break;
@@ -1058,7 +1081,7 @@ int32_t moxt_map_docs(MoxtState* st, const uint8_t* data, int64_t len,
     for (int64_t g = start - 1; g >= scanned; g--) {
       if (data[g] == '\n') {
         line_start = g + 1;
-        st->chunk.new_epoch();  // fresh per-doc distinct set
+        st->doc.new_epoch();  // fresh per-doc distinct set
         break;
       }
     }
@@ -1075,7 +1098,7 @@ int32_t moxt_map_docs(MoxtState* st, const uint8_t* data, int64_t len,
       h = moxt64(low + start, tlen);
     }
     // "new this doc" -> emit the pair and make sure the dict knows the term
-    Table& t = st->chunk;
+    Table& t = st->doc;
     if (t.n * 2 >= t.cap) t.grow();
     int64_t mask = t.cap - 1;
     int64_t j = h & mask;
@@ -1105,8 +1128,9 @@ int32_t moxt_map_docs(MoxtState* st, const uint8_t* data, int64_t len,
       j = (j + 1) & mask;
     }
     if (fresh) {
-      st->pair_push(h, base_doc + line_start);
-      if (dict_upsert(st, h, w0, w1, tlen, low + start) != UP_OK) {
+      if (flags & kDocsPairs) st->pair_push(h, base_doc + line_start);
+      if ((flags & kDocsDict) &&
+          dict_upsert(st, h, w0, w1, tlen, low + start) != UP_OK) {
         st->error = 1;
         return 1;
       }
