@@ -1026,11 +1026,12 @@ int64_t moxt_chunk_tokens(MoxtState* st) { return st->n_tokens; }
 // per document, where a document is one line and its id is the absolute
 // byte offset of its first byte (base_doc + in-chunk offset) — unique,
 // monotone in document order, and derivable per chunk with no global line
-// counter.  Per-doc distinctness reuses the epoch trick: the chunk table
-// gets a fresh epoch per document, so "new this epoch" == "first time in
-// this doc".  Dictionary entries are inserted inline (the chunk table only
-// holds the current doc).  BASELINE.json config #4; generalizes the
-// reference's per-chunk HashMap (main.rs:94-101) to per-document key sets.
+// counter.  Per-doc distinctness reuses the epoch trick on the dedicated
+// st->doc table (NOT st->chunk): it gets a fresh epoch per document, so
+// "new this epoch" == "first time in this doc".  Dictionary entries are
+// inserted inline (the doc table only holds the current doc).
+// BASELINE.json config #4; generalizes the reference's per-chunk HashMap
+// (main.rs:94-101) to per-document key sets.
 // flags for moxt_map_docs_ex: which per-fresh-pair stores to run.  The
 // default (both) is the production path; the reduced forms exist to
 // DECOMPOSE the doc-mode scan cost (benchmarks/RESULTS.md round 4) and to
