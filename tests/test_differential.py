@@ -36,11 +36,19 @@ def _adversarial_corpus(seed: int) -> bytes:
         b"\xc3\xa9t\xc3\xa9",                        # multibyte UTF-8 (ascii
         b"z",                                        #  mode treats as bytes)
     ]
+    # varied separators: single space (the zero-copy contiguous n-gram
+    # window), plus multi-byte runs and tabs/vertical-tabs (the scratch
+    # join fallback) — both joins must hash identically
+    seps = [b" ", b" ", b" ", b"  ", b"\t", b" \t ", b"\x0b"]
     lines = []
     for _ in range(int(rng.integers(100, 300))):
         k = int(rng.integers(0, 9))
-        line = b" ".join(vocab[int(i)]
-                         for i in rng.integers(0, len(vocab), k))
+        toks = [vocab[int(i)] for i in rng.integers(0, len(vocab), k)]
+        line = b""
+        for j, t in enumerate(toks):
+            if j:
+                line += seps[int(rng.integers(0, len(seps)))]
+            line += t
         if rng.random() < 0.1:
             line += b"\r"          # CRLF: \r is whitespace per the reference
         lines.append(line)

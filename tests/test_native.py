@@ -83,6 +83,19 @@ def test_trigram_sanity():
     assert out.records_in == 2
 
 
+def test_trigram_mixed_separators_hash_identically():
+    """Single-space windows take the zero-copy contiguous path; tab /
+    multi-space windows take the scratch join.  Both must emit the SAME
+    joined-bytes keys ("a b c") for the same token sequence."""
+    a = native.map_ngram(b"a b c d", 3)
+    b = native.map_ngram(b"a\tb  c \t d", 3)
+    for out in (a, b):
+        k = join_u64(out.hi, out.lo).tolist()
+        dd = dict(out.dictionary.items())
+        got = {dd[h]: v for h, v in zip(k, out.values.tolist())}
+        assert got == {b"a b c": 1, b"b c d": 1}, got
+
+
 def test_count_u64_matches_numpy_unique():
     """Fused MSD+LSD unique+count == np.unique across shapes that stress
     it: uniform hashes, heavy Zipf duplicates (one bucket >> cache), all
