@@ -67,6 +67,10 @@ def build_parser() -> argparse.ArgumentParser:
                    default="auto",
                    help="inverted-index pair sort placement (auto: host — "
                         "the measured winner on a remote-attached chip)")
+    p.add_argument("--collect-max-rows", type=int, default=0,
+                   help="resident-row cap for the collect engines before "
+                        "the disk-bucket spill (hash-only counts) or a "
+                        "loud abort (pair/value jobs); 0 = engine defaults")
     p.add_argument("--rescan-full", action="store_true",
                    help="hash-only mode: rescan the whole corpus when "
                         "resolving winner strings (extends the collision "
@@ -126,6 +130,7 @@ def config_from_args(args: argparse.Namespace) -> JobConfig:
         keep_intermediates=args.keep_intermediates,
         trace_dir=args.trace_dir,
         rescan_full=args.rescan_full,
+        collect_max_rows=args.collect_max_rows,
         hll_precision=args.hll_precision,
         kmeans_k=args.kmeans_k,
         kmeans_iters=args.kmeans_iters,

@@ -94,6 +94,11 @@ class JobConfig:
     kmeans_k: int = 16
     #: k-means: iterations to run
     kmeans_iters: int = 1
+    #: collect engines: resident-row cap before the host collect-reduce
+    #: switches to its disk-bucket spill (hash-only count jobs) or the
+    #: engines abort (explicit-value / pair jobs).  0 = engine defaults
+    #: (host collect 2^28, pair collect 2^27).
+    collect_max_rows: int = 0
 
     def validate(self) -> "JobConfig":
         if self.tokenizer not in ("ascii", "unicode"):
@@ -121,6 +126,8 @@ class JobConfig:
             raise ValueError("top_k and num_map_workers must be positive")
         if self.kmeans_k <= 0 or self.kmeans_iters <= 0:
             raise ValueError("kmeans_k and kmeans_iters must be positive")
+        if self.collect_max_rows < 0:
+            raise ValueError("collect_max_rows must be >= 0 (0 = default)")
         from map_oxidize_tpu.workloads.distinct import HLL_P_MIN, HLL_P_MAX
 
         if not HLL_P_MIN <= self.hll_precision <= HLL_P_MAX:

@@ -415,8 +415,10 @@ def run_distributed_job(config: JobConfig, workload: str
             make_inverted_index,
         )
 
+        from map_oxidize_tpu.runtime.driver import collect_engine_kw
+
         mapper = make_inverted_index(config.tokenizer, config.use_native)
-        engine = DistributedCollectEngine(config)
+        engine = DistributedCollectEngine(config, **collect_engine_kw(config))
     else:
         raise ValueError(f"unknown distributed workload {workload!r}")
     P_ = engine.n_proc

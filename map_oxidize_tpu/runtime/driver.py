@@ -58,6 +58,13 @@ def effective_num_shards(config: JobConfig) -> int:
     return n
 
 
+def collect_engine_kw(config: JobConfig) -> dict:
+    """Constructor kwargs shared by every collect-engine site: the 0
+    sentinel means 'engine default', so the key is only passed when set."""
+    return ({"max_rows": config.collect_max_rows}
+            if config.collect_max_rows else {})
+
+
 def make_engine(config: JobConfig, reducer, value_shape=(), value_dtype=np.int32,
                 wide_keys: bool = False):
     """Pick the engine: shard count selects single-chip vs the all_to_all
@@ -85,7 +92,8 @@ def make_engine(config: JobConfig, reducer, value_shape=(), value_dtype=np.int32
 
             return HostCollectReduceEngine(config, reducer,
                                            value_shape=value_shape,
-                                           value_dtype=value_dtype)
+                                           value_dtype=value_dtype,
+                                           **collect_engine_kw(config))
     if n <= 1:
         return DeviceReduceEngine(config, reducer, value_shape=value_shape,
                                   value_dtype=value_dtype)
@@ -433,11 +441,11 @@ def run_inverted_index_job(config: JobConfig) -> InvertedIndexResult:
             _log.info("collect_sort=%r applies to the single-chip engine "
                       "only; the sharded path sorts per shard on device",
                       config.collect_sort)
-        engine = ShardedCollectEngine(config)
+        engine = ShardedCollectEngine(config, **collect_engine_kw(config))
     else:
         from map_oxidize_tpu.runtime.collect import CollectEngine
 
-        engine = CollectEngine(config)
+        engine = CollectEngine(config, **collect_engine_kw(config))
     dictionary = HashDictionary()
     records_in = 0
     n_chunks = 0
