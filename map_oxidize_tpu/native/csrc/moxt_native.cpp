@@ -1572,34 +1572,44 @@ int32_t moxt_sort_kd(uint64_t* keys, int64_t* docs, int64_t n) {
 // the same size.  The engine's staged feed arrives as many blocks; a
 // separate O(n) concatenation before moxt_sort_kd cost ~0.3 s at 34M rows
 // (bigram 256MB) — here the first scatter IS the concatenation.
+// 16-bit digits for the keys-only blocks sort: 4 passes instead of 6.
+// Measured A/B at the bigram shape (34M keys, 6.4M distinct, Zipf
+// duplicates): ~10% faster than 11-bit despite the 64k-bucket scatter's
+// extra TLB pressure — fewer full-array passes win.  The KD (16-byte
+// record) sort and the fused count's in-cache LSD keep 11-bit digits
+// (their cache economics differ and were not re-measured).
+static const int kLsdBits = 16;
+static const int64_t kLsdSize = 1 << kLsdBits;
+static const int kLsdPasses = (64 + kLsdBits - 1) / kLsdBits;  // 4
+
 int32_t moxt_sort_u64_blocks(uint64_t* const* blocks, const int64_t* lens,
                              int32_t nblocks, uint64_t* out, uint64_t* tmp,
                              int64_t n) {
   if (n <= 0) return 0;
   int64_t* hist =
-      static_cast<int64_t*>(calloc(kRadixPasses * kRadixSize, 8));
+      static_cast<int64_t*>(calloc(kLsdPasses * kLsdSize, 8));
   if (!hist) return -1;
   for (int32_t b = 0; b < nblocks; b++) {
     const uint64_t* blk = blocks[b];
     const int64_t ln = lens[b];
     for (int64_t i = 0; i < ln; i++) {
       uint64_t k = blk[i];
-      for (int p = 0; p < kRadixPasses; p++)
-        hist[p * kRadixSize + ((k >> (p * kRadixBits)) & (kRadixSize - 1))]++;
+      for (int p = 0; p < kLsdPasses; p++)
+        hist[p * kLsdSize + ((k >> (p * kLsdBits)) & (kLsdSize - 1))]++;
     }
   }
-  bool skip[kRadixPasses];
+  bool skip[kLsdPasses];
   int live = 0;
-  for (int p = 0; p < kRadixPasses; p++) {
-    int64_t* h = hist + p * kRadixSize;
+  for (int p = 0; p < kLsdPasses; p++) {
+    int64_t* h = hist + p * kLsdSize;
     int64_t nonzero = 0;
-    for (int64_t bb = 0; bb < kRadixSize && nonzero <= 1; bb++)
+    for (int64_t bb = 0; bb < kLsdSize && nonzero <= 1; bb++)
       if (h[bb]) nonzero++;
     skip[p] = nonzero <= 1;
     if (skip[p]) continue;
     live++;
     int64_t sum = 0;
-    for (int64_t bb = 0; bb < kRadixSize; bb++) {
+    for (int64_t bb = 0; bb < kLsdSize; bb++) {
       int64_t c = h[bb];
       h[bb] = sum;
       sum += c;
@@ -1618,21 +1628,21 @@ int32_t moxt_sort_u64_blocks(uint64_t* const* blocks, const int64_t* lens,
   uint64_t* dst = (live % 2) ? out : tmp;
   uint64_t* src = nullptr;
   bool first = true;
-  for (int p = 0; p < kRadixPasses; p++) {
+  for (int p = 0; p < kLsdPasses; p++) {
     if (skip[p]) continue;
-    int64_t* h = hist + p * kRadixSize;
-    const int shift = p * kRadixBits;
+    int64_t* h = hist + p * kLsdSize;
+    const int shift = p * kLsdBits;
     if (first) {
       for (int32_t b = 0; b < nblocks; b++) {
         const uint64_t* blk = blocks[b];
         const int64_t ln = lens[b];
         for (int64_t i = 0; i < ln; i++)
-          dst[h[(blk[i] >> shift) & (kRadixSize - 1)]++] = blk[i];
+          dst[h[(blk[i] >> shift) & (kLsdSize - 1)]++] = blk[i];
       }
       first = false;
     } else {
       for (int64_t i = 0; i < n; i++)
-        dst[h[(src[i] >> shift) & (kRadixSize - 1)]++] = src[i];
+        dst[h[(src[i] >> shift) & (kLsdSize - 1)]++] = src[i];
     }
     src = dst;
     dst = (dst == out) ? tmp : out;
