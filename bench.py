@@ -230,9 +230,15 @@ def main() -> int:
     with open(base_corpus, "rb") as f:
         slice_bytes = f.read(cap)
     slice_bytes = slice_bytes[: slice_bytes.rfind(b"\n") + 1]
-    t0 = time.perf_counter()
-    base_counts = wordcount_model([slice_bytes])
-    base_s = time.perf_counter() - t0
+    # best-of-2: the HEADLINE ratio divides by this one number, and the
+    # ±15% host drift (benchmarks/RESULTS.md) on a single reading moves
+    # every row of the artifact; a second 8MB pass costs ~9s
+    base_s = None
+    for _ in range(2):
+        t0 = time.perf_counter()
+        base_counts = wordcount_model([slice_bytes])
+        dt = time.perf_counter() - t0
+        base_s = dt if base_s is None else min(base_s, dt)
     base_rate = sum(base_counts.values()) / base_s
 
     # --- parity gate: our top-k on the slice must equal the model's
