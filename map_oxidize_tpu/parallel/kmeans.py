@@ -28,7 +28,7 @@ from map_oxidize_tpu.parallel.mesh import SHARD_AXIS, make_mesh
 
 def kmeans_fit_sharded(points, centroids, iters: int = 1, mesh=None,
                        num_shards: int = 0, backend: str = "auto",
-                       on_iter=None):
+                       on_iter=None, timings: dict | None = None):
     """Run ``iters`` k-means iterations with points sharded over the mesh.
 
     ``points``: host ``(n, d)`` float32 (rows pad to a multiple of the shard
@@ -38,7 +38,12 @@ def kmeans_fit_sharded(points, centroids, iters: int = 1, mesh=None,
     ``on_iter(i, centroids_np)`` (checkpoint hook): when given, the compiled
     body runs one iteration per call — points stay sharded in HBM; only the
     replicated ``(k, d)`` centroids and one psum per iteration move.
+
+    ``timings`` mirrors :func:`workloads.kmeans.kmeans_fit_device`:
+    ``transfer_s`` (the one sharded put) and, on the uninterleaved path
+    only, ``iter_s`` (fetch-forced iteration chain — the MFU region).
     """
+    import time
     if mesh is None:
         mesh = make_mesh(num_shards, backend)
     S = mesh.shape[SHARD_AXIS]
@@ -82,11 +87,20 @@ def kmeans_fit_sharded(points, centroids, iters: int = 1, mesh=None,
     ))
     row = NamedSharding(mesh, P(SHARD_AXIS))
     rep = NamedSharding(mesh, P())
+    t0 = time.perf_counter()
     p_dev = jax.device_put(points, row)
     w_dev = jax.device_put(weights, row)
+    p_dev.block_until_ready()
+    w_dev.block_until_ready()
+    if timings is not None:
+        timings["transfer_s"] = time.perf_counter() - t0
     c_dev = jax.device_put(centroids, rep)
+    t0 = time.perf_counter()
     if on_iter is None:
-        return np.asarray(fit_fn(p_dev, w_dev, c_dev))
+        out = np.asarray(fit_fn(p_dev, w_dev, c_dev))  # asarray forces
+        if timings is not None:
+            timings["iter_s"] = time.perf_counter() - t0
+        return out
     c = c_dev
     for i in range(iters):
         c = fit_fn(p_dev, w_dev, c)

@@ -716,10 +716,14 @@ def run_kmeans_job(config: JobConfig, centroids: np.ndarray | None = None
             if n_shards > 1:
                 from map_oxidize_tpu.parallel.kmeans import kmeans_fit_sharded
 
+                timings = {}
                 centroids = kmeans_fit_sharded(
                     np.asarray(pts, np.float32), centroids,
                     iters=remaining, num_shards=config.num_shards,
-                    backend=config.backend, on_iter=on_iter)
+                    backend=config.backend, on_iter=on_iter,
+                    timings=timings)
+                for tk, tv in timings.items():
+                    metrics.set(f"time/{tk}", round(tv, 4))
             else:
                 from map_oxidize_tpu.workloads.kmeans import kmeans_fit_device
 
