@@ -246,3 +246,19 @@ def test_process_death_aborts_cleanly(tmp_path):
     assert "(timeout)" not in logs[0], (
         "survivor hung past the collective timeout instead of aborting:\n"
         + logs[0][-2000:])
+
+
+def test_gather_strings_single_process():
+    """Collective semantics degrade to identity in a single process:
+    known hashes resolve, unknown hashes are absent, empty input is
+    empty.  (Cross-process resolution is covered by the word-top tests
+    above — each word's bytes live in only some processes.)"""
+    from map_oxidize_tpu.ops.hashing import HashDictionary, moxt64_bytes
+    from map_oxidize_tpu.parallel.distributed import gather_strings
+
+    d = HashDictionary()
+    h1, h2 = moxt64_bytes(b"alpha"), moxt64_bytes(b"beta")
+    d.add(h1, b"alpha")
+    got = gather_strings([h1, h2], d)
+    assert got == {h1: b"alpha"}
+    assert gather_strings([], d) == {}
