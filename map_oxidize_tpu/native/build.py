@@ -128,6 +128,15 @@ def _load_lib():
         lib.moxt_map_range_hashes.restype = ctypes.c_int64
         lib.moxt_map_range_hashes.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64]
+        lib.moxt_map_hll.restype = ctypes.c_int32
+        lib.moxt_map_hll.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                     ctypes.c_int64, ctypes.c_int32]
+        lib.moxt_hll_read.restype = None
+        lib.moxt_hll_read.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+        lib.moxt_map_range_hll.restype = ctypes.c_int64
+        lib.moxt_map_range_hll.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int32]
         lib.moxt_resolve_begin.restype = ctypes.c_int32
         lib.moxt_resolve_begin.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                                            ctypes.c_int64]
@@ -316,14 +325,12 @@ class NativeStream:
         return MapOutput(hi=hi, lo=lo, values=counts, dictionary=d,
                          records_in=records)
 
-    def iter_file(self, path: str, chunk_bytes: int, start_offset: int = 0):
-        """Map a file via the C++ mmap path: zero kernel->user copies, chunk
-        cuts chosen in C (last newline, then last whitespace, then hard cut —
-        the same bounded-carry policy as io.splitter.iter_chunks).  Yields
-        ``(MapOutput, next_offset)`` per chunk; ``start_offset`` resumes at a
-        previous run's cut boundary (checkpoint/resume contract: the cut
-        policy is deterministic in (offset, chunk_bytes), so the resumed
-        chunk stream is identical to a fresh run's tail)."""
+    def _iter_file_ranges(self, path: str, start_offset: int, map_range,
+                          collect, what: str):
+        """Shared mmap range-iteration skeleton for every file iterator:
+        open/size, per-range ``map_range(file, off) -> consumed`` under the
+        lock, negative-rc error mapping, stall detection, ``collect()``
+        readback, close.  Yields ``(collected, next_offset)``."""
         f = self._lib.moxt_file_open(os.fsencode(path))
         if not f:
             raise OSError(f"cannot open/mmap {path!r}")
@@ -332,17 +339,31 @@ class NativeStream:
             off = start_offset
             while off < size:
                 with self._lock:
-                    consumed = int(self._lib.moxt_map_range(
-                        self._st, f, off, chunk_bytes))
+                    consumed = int(map_range(f, off))
                     if consumed < 0:
                         _raise_map_error(-consumed)
                     if consumed == 0:
-                        raise RuntimeError(f"native map_range stalled at {off}")
-                    out = self._collect_locked(0, drain_dict=True)
+                        raise RuntimeError(
+                            f"native {what} stalled at {off}")
+                    out = collect()
                 off += consumed
                 yield out, off
         finally:
             self._lib.moxt_file_close(f)
+
+    def iter_file(self, path: str, chunk_bytes: int, start_offset: int = 0):
+        """Map a file via the C++ mmap path: zero kernel->user copies, chunk
+        cuts chosen in C (last newline, then last whitespace, then hard cut —
+        the same bounded-carry policy as io.splitter.iter_chunks).  Yields
+        ``(MapOutput, next_offset)`` per chunk; ``start_offset`` resumes at a
+        previous run's cut boundary (checkpoint/resume contract: the cut
+        policy is deterministic in (offset, chunk_bytes), so the resumed
+        chunk stream is identical to a fresh run's tail)."""
+        return self._iter_file_ranges(
+            path, start_offset,
+            lambda f, off: self._lib.moxt_map_range(
+                self._st, f, off, chunk_bytes),
+            lambda: self._collect_locked(0, drain_dict=True), "map_range")
 
     def _collect_pairs_locked(self) -> MapOutput:
         n = int(self._lib.moxt_pairs_n(self._st))
@@ -379,26 +400,11 @@ class NativeStream:
         offsets of line starts.  Yields ``(MapOutput, next_offset)`` per
         chunk; ``start_offset`` resumes at a previous run's boundary (the
         doc-mode cut policy is deterministic in (offset, chunk_bytes))."""
-        f = self._lib.moxt_file_open(os.fsencode(path))
-        if not f:
-            raise OSError(f"cannot open/mmap {path!r}")
-        try:
-            size = int(self._lib.moxt_file_size(f))
-            off = start_offset
-            while off < size:
-                with self._lock:
-                    consumed = int(self._lib.moxt_map_range_docs(
-                        self._st, f, off, chunk_bytes))
-                    if consumed < 0:
-                        _raise_map_error(-consumed)
-                    if consumed == 0:
-                        raise RuntimeError(
-                            f"native map_range_docs stalled at {off}")
-                    out = self._collect_pairs_locked()
-                off += consumed
-                yield out, off
-        finally:
-            self._lib.moxt_file_close(f)
+        return self._iter_file_ranges(
+            path, start_offset,
+            lambda f, off: self._lib.moxt_map_range_docs(
+                self._st, f, off, chunk_bytes),
+            self._collect_pairs_locked, "map_range_docs")
 
     def map_chunk_hashes(self, chunk) -> MapOutput:
         """Hash-only map of one chunk: one raw n-gram hash per window, no
@@ -428,26 +434,41 @@ class NativeStream:
         """mmap hash-only map over a file; same cut policy (and therefore
         the same resume offsets) as :meth:`iter_file`.  Yields
         ``(MapOutput, next_offset)``."""
-        f = self._lib.moxt_file_open(os.fsencode(path))
-        if not f:
-            raise OSError(f"cannot open/mmap {path!r}")
-        try:
-            size = int(self._lib.moxt_file_size(f))
-            off = start_offset
-            while off < size:
-                with self._lock:
-                    consumed = int(self._lib.moxt_map_range_hashes(
-                        self._st, f, off, chunk_bytes))
-                    if consumed < 0:
-                        _raise_map_error(-consumed)
-                    if consumed == 0:
-                        raise RuntimeError(
-                            f"native map_range_hashes stalled at {off}")
-                    out = self._collect_hashes_locked(0)
-                off += consumed
-                yield out, off
-        finally:
-            self._lib.moxt_file_close(f)
+        return self._iter_file_ranges(
+            path, start_offset,
+            lambda f, off: self._lib.moxt_map_range_hashes(
+                self._st, f, off, chunk_bytes),
+            lambda: self._collect_hashes_locked(0), "map_range_hashes")
+
+    def map_chunk_hll(self, chunk, p: int):
+        """HLL-fold map of one chunk: the scan max-folds (top-p-bits bucket,
+        leading-zero rank) into ``2^p`` uint8 registers in C — no hash
+        emission, no host-side extraction.  Returns ``(registers, n_tokens)``
+        with the same register semantics as
+        workloads.distinct.hll_registers."""
+        view = np.frombuffer(chunk, np.uint8)
+        with self._lock:
+            rc = self._lib.moxt_map_hll(self._st, view.ctypes.data,
+                                        view.size, p)
+            return self._collect_hll_locked(rc, p)
+
+    def _collect_hll_locked(self, rc: int, p: int):
+        _raise_map_error(rc)
+        regs = np.empty(1 << p, np.uint8)
+        self._lib.moxt_hll_read(self._st, regs.ctypes.data)
+        return regs, int(self._lib.moxt_chunk_tokens(self._st))
+
+    def iter_file_hll(self, path: str, chunk_bytes: int, p: int,
+                      start_offset: int = 0):
+        """mmap HLL-fold map over a file; same cut policy (and resume
+        offsets) as :meth:`iter_file_hashes`.  Yields
+        ``(registers, n_tokens, next_offset)``."""
+        for (regs, n_tokens), off in self._iter_file_ranges(
+                path, start_offset,
+                lambda f, off: self._lib.moxt_map_range_hll(
+                    self._st, f, off, chunk_bytes, p),
+                lambda: self._collect_hll_locked(0, p), "map_range_hll"):
+            yield regs, n_tokens, off
 
     def resolve_file(self, path: str, chunk_bytes: int, hashes: np.ndarray,
                      early_stop: bool = True):
@@ -728,6 +749,13 @@ class StreamPool:
 
     def map_chunk_hashes(self, chunk) -> MapOutput:
         return self.get().map_chunk_hashes(chunk)
+
+    def map_chunk_hll(self, chunk, p: int):
+        return self.get().map_chunk_hll(chunk, p)
+
+    def iter_file_hll(self, path: str, chunk_bytes: int, p: int,
+                      start_offset: int = 0):
+        return self.get().iter_file_hll(path, chunk_bytes, p, start_offset)
 
     def resolve_file(self, path: str, chunk_bytes: int, hashes,
                      early_stop: bool = True):

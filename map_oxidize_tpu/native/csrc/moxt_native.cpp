@@ -385,6 +385,9 @@ struct MoxtState {
   // hash-only mode: raw n-gram hash emission buffer (no tables, no strings)
   uint64_t* hx_h = nullptr;
   int64_t hx_n = 0, hx_cap = 0;
+  // hll mode: 2^p max-rank registers folded in-scan (distinct workload)
+  uint8_t* hll_regs = nullptr;
+  int32_t hll_p = 0;  // current allocation's p; 0 = unallocated
   // hash->bytes resolver: open-addressed query set + found-key storage.
   // q_ref[j] == -1 means wanted-but-unseen; >= 0 is the resolve_arena
   // offset of the first matching key's bytes.
@@ -879,6 +882,7 @@ void moxt_free(MoxtState* st) {
   free(st->pair_h);
   free(st->pair_doc);
   free(st->hx_h);
+  free(st->hll_regs);
   free(st->q_h);
   free(st->q_ref);
   free(st->q_len);
@@ -1357,6 +1361,69 @@ int64_t moxt_map_range_hashes(MoxtState* st, MoxtFile* f, int64_t off,
   if (!st || !f || off < 0 || off >= f->size || want <= 0) return 0;
   int64_t len = range_cut(st, f, off, want);
   int32_t rc = moxt_map_hashes(st, f->data + off, len);
+  if (rc != 0) return -(int64_t)rc;
+  return len;
+}
+
+// ---------------------------------------------------------------------------
+// HLL-fold map (distinct workload).
+//
+// bucket = top-p hash bits, rank = leading-zero count of the remaining
+// 64-p bits + 1; registers keep the per-bucket max.  Folding in-scan
+// replaces the hash emission buffer entirely: ~2^p bytes of L1-resident
+// registers instead of 8 bytes/token of DRAM stores plus a 34M-row NumPy
+// bincount on the Python side (round-4 verdict: that extraction held
+// distinct to ~170 MB/s against the 544-589 MB/s hash-only scan).
+// rank matches workloads/distinct.py hll_registers: for the masked
+// remainder w, frexp gives 64-p+1-exp = clz64(w)-p+1; w==0 -> 64-p+1.
+// ---------------------------------------------------------------------------
+
+// Fold one chunk into the registers.  0 ok, 3 bad UTF-8, 2 bad state/p.
+int32_t moxt_map_hll(MoxtState* st, const uint8_t* data, int64_t len,
+                     int32_t p) {
+  if (!st || st->error == 2) return 2;
+  if (p < 4 || p > 24) return 2;
+  st->error = 0;
+  int64_t m = (int64_t)1 << p;
+  if (st->hll_p != p) {
+    free(st->hll_regs);
+    st->hll_regs = static_cast<uint8_t*>(malloc(m));
+    if (!st->hll_regs) {
+      st->hll_p = 0;
+      return 2;
+    }
+    st->hll_p = p;
+  }
+  memset(st->hll_regs, 0, m);
+  uint8_t* regs = st->hll_regs;
+  const int32_t shift = 64 - p;
+  const uint64_t mask = (~0ULL) >> p;
+  int32_t rc = scan_ngrams(
+      st, data, len,
+      [regs, p, shift, mask](const uint8_t*, uint32_t, uint64_t h) {
+        uint64_t b = h >> shift;
+        uint64_t w = h & mask;
+        uint8_t rank = w ? (uint8_t)(__builtin_clzll(w) - p + 1)
+                         : (uint8_t)(shift + 1);
+        if (rank > regs[b]) regs[b] = rank;
+        return (int)UP_OK;
+      });
+  if (rc) st->error = rc;
+  return rc;
+}
+
+// Read back the 2^p registers of the last moxt_map_hll call.
+void moxt_hll_read(MoxtState* st, uint8_t* out) {
+  if (st->hll_p) memcpy(out, st->hll_regs, (int64_t)1 << st->hll_p);
+}
+
+// mmap-range variant; same cut policy (same resume offsets) as
+// moxt_map_range_hashes.
+int64_t moxt_map_range_hll(MoxtState* st, MoxtFile* f, int64_t off,
+                           int64_t want, int32_t p) {
+  if (!st || !f || off < 0 || off >= f->size || want <= 0) return 0;
+  int64_t len = range_cut(st, f, off, want);
+  int32_t rc = moxt_map_hll(st, f->data + off, len, p);
   if (rc != 0) return -(int64_t)rc;
   return len;
 }

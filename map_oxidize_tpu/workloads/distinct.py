@@ -106,37 +106,40 @@ class DistinctMapper(Mapper):
             self._native = bindings.stream_or_none(ngram=1,
                                                    tokenizer=tokenizer)
 
-    def _registers_output(self, hashes: np.ndarray,
-                          n_tokens: int) -> MapOutput:
-        regs = hll_registers(hashes, self.p)
+    def _registers_output(self, regs: np.ndarray, n_tokens: int) -> MapOutput:
+        """Dense ``(2^p,)`` registers (int32 or uint8) -> sparse MapOutput
+        of live (bucket, max-rank) rows."""
         live = np.flatnonzero(regs)
         return MapOutput(hi=np.zeros(live.shape[0], np.uint32),
                          lo=live.astype(np.uint32),
-                         values=regs[live],
+                         values=regs[live].astype(np.int32, copy=False),
                          records_in=n_tokens)
 
     def map_chunk(self, chunk: bytes) -> MapOutput:
         if self._native is not None:
-            out = self._native.map_chunk_hashes(chunk)
-            return self._registers_output(out.keys64, out.records_in)
+            regs, n_tokens = self._native.map_chunk_hll(chunk, self.p)
+            return self._registers_output(regs, n_tokens)
         from map_oxidize_tpu.ops.hashing import moxt64_bytes
         from map_oxidize_tpu.workloads.wordcount import tokenize
 
         toks = tokenize(chunk, self.tokenizer)
         hashes = np.fromiter((moxt64_bytes(t) for t in toks),
                              np.uint64, count=len(toks))
-        return self._registers_output(hashes, len(toks))
+        return self._registers_output(hll_registers(hashes, self.p),
+                                      len(toks))
 
     def map_file(self, path: str, chunk_bytes: int, start_offset: int = 0):
-        """Native mmap fast path: raw token hashes per chunk (the hash-only
-        scan), registers vectorized on top."""
+        """Native mmap fast path: the C++ scan max-folds (bucket, rank)
+        into the ``2^p`` registers in-loop — no hash buffer, no host-side
+        extraction (the round-4 NumPy bincount held distinct to ~170 MB/s
+        against the 544-589 MB/s hash-only scan)."""
         if self._native is None:
             return None
 
         def _iter():
-            for out, off in self._native.iter_file_hashes(
-                    path, chunk_bytes, start_offset):
-                yield self._registers_output(out.keys64, out.records_in), off
+            for regs, n_tokens, off in self._native.iter_file_hll(
+                    path, chunk_bytes, self.p, start_offset):
+                yield self._registers_output(regs, n_tokens), off
 
         return _iter()
 

@@ -159,3 +159,45 @@ def test_registers_high_precision_branch(rng):
         rank = (64 - p) + 1 if w == 0 else (64 - p) - w.bit_length() + 1
         want[b] = max(want[b], rank)
     np.testing.assert_array_equal(regs, want)
+
+
+def test_native_hll_fold_matches_hash_extraction(tmp_path, rng):
+    """The in-scan C++ register fold must equal hll_registers() applied to
+    the raw hash stream of the same chunks — for several p values, both
+    tokenizers, and the mmap file iterator (same cut offsets as the
+    hash-only scan)."""
+    from map_oxidize_tpu.native import bindings
+
+    if bindings.load_or_none() is None:
+        pytest.skip("native build unavailable")
+    from map_oxidize_tpu.native.build import NativeStream
+
+    blob = b"\n".join(
+        b" ".join(b"t%04x" % int(v) for v in rng.integers(0, 1 << 16, 12))
+        for _ in range(400)) + b"\n \n\tmixed  WS\r\n"
+    for tokenizer in ("ascii", "unicode"):
+        s = NativeStream(1, tokenizer)
+        try:
+            for p in (11, 14, 18):
+                regs, nt = s.map_chunk_hll(blob, p)
+                out = s.map_chunk_hashes(blob)
+                assert nt == out.records_in
+                np.testing.assert_array_equal(
+                    regs.astype(np.int32), hll_registers(out.keys64, p))
+        finally:
+            s.close()
+    path = tmp_path / "hll.txt"
+    path.write_bytes(blob * 8)
+    s = NativeStream(1, "ascii")
+    try:
+        folded = list(s.iter_file_hll(str(path), 4096, 12))
+        raw = list(s.iter_file_hashes(str(path), 4096))
+        assert [off for _, _, off in folded] == [off for _, off in raw]
+        acc = np.zeros(1 << 12, np.int32)
+        for regs, _, _ in folded:
+            acc = np.maximum(acc, regs.astype(np.int32))
+        want = hll_registers(
+            np.concatenate([o.keys64 for o, _ in raw]), 12)
+        np.testing.assert_array_equal(acc, want)
+    finally:
+        s.close()
