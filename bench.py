@@ -133,6 +133,13 @@ def make_realtext_corpus(path: str, target_mb: int) -> None:
             base_bytes += len(raw) + 1
         if base_bytes > 24 * 1024 * 1024:
             break
+    if not base:
+        # no prose-like files in this image: fail loudly rather than tile
+        # a b"\n" blob into a zero-token corpus (the baseline would then
+        # divide by zero; advisor r4)
+        raise RuntimeError(
+            "make_realtext_corpus found no prose-like files under the "
+            "image glob paths; skip the realtext bench entry on this host")
     blob = b"\n".join(base) + b"\n"
     target = target_mb * 1024 * 1024
     tmp = path + ".tmp"
@@ -220,6 +227,12 @@ def main() -> int:
     from map_oxidize_tpu.runtime import run_job
     from map_oxidize_tpu.workloads.reference_model import top_k_model, wordcount_model
 
+    # --- session probes (round-4 verdict #5): the artifact must
+    # self-describe its session so a reader can normalize across the
+    # host's ±15% single-thread drift and the 50-1200 MB/s link variance
+    # (benchmarks/RESULTS.md) without re-running anything.
+    probes = _session_probes()
+
     # --- CPU reference baseline: single-thread, reference semantics
     # (tokenize per main.rs:94-101, merge per main.rs:131-134), measured on a
     # capped slice and rate-extrapolated (it's O(n))
@@ -264,10 +277,59 @@ def main() -> int:
     # --- per-size sweep; the LAST size is the headline
     per_size = []
     headline = None
+    headline_pairs = None
     for mb in BENCH_SIZES:
         corpus = os.path.join(CACHE_DIR, f"zipf_{mb}mb.txt")
         if not os.path.isfile(corpus):
             make_corpus(corpus, mb)
+        if mb == BENCH_SIZES[-1]:
+            # HEADLINE: alternate baseline and framework phases, 3 pairs,
+            # and cite the MEDIAN per-pair ratio (round-4 verdict #5: the
+            # numerator was stable across rounds while a single up-front
+            # baseline reading swung the artifact's every row by ±39%;
+            # same-session A/B is the discipline bigram already follows)
+            slice_words = sum(base_counts.values())
+            fw_cfg = JobConfig(
+                input_path=corpus,
+                output_path=os.path.join(CACHE_DIR, "final_result.txt"),
+                backend="auto", top_k=TOP_K, metrics=True)
+            run_job(JobConfig(input_path=corpus, output_path="",
+                              backend="auto", metrics=False),
+                    "wordcount")  # warm: compile + transfer shapes
+            pairs = []
+            result = None
+            for _ in range(3):
+                _release_heap()
+                t0 = time.perf_counter()
+                wordcount_model([slice_bytes])
+                b_rate = slice_words / (time.perf_counter() - t0)
+                _release_heap()  # the model's ~2M boxed objects tax GC
+                t0 = time.perf_counter()
+                result = run_job(fw_cfg, "wordcount")
+                secs = time.perf_counter() - t0
+                words = result.metrics["records_in"]
+                pairs.append({
+                    "cpu_baseline_words_per_sec": round(b_rate, 1),
+                    "words_per_sec": round(words / secs, 1),
+                    "ratio": round(words / secs / b_rate, 3),
+                })
+            ratios = sorted(p["ratio"] for p in pairs)
+            rates = sorted(p["words_per_sec"] for p in pairs)
+            med_ratio, med_rate = ratios[1], rates[1]
+            headline = (med_rate, words, med_ratio)
+            headline_pairs = pairs
+            per_size.append({
+                "corpus_mb": mb,
+                "words": int(words),
+                "median_words_per_sec": round(med_rate, 1),
+                "vs_baseline_median_of_pairs": med_ratio,
+                "pairs": pairs,
+                "distinct_keys": int(result.metrics["distinct_keys"]),
+                "phases": {k: round(v, 4)
+                           for k, v in result.metrics.items()
+                           if k.startswith("time/")},
+            })
+            continue
         result, secs, times = _run_size(run_job, JobConfig, corpus, warm=True)
         words = result.metrics["records_in"]
         rate = words / secs
@@ -282,7 +344,7 @@ def main() -> int:
             "phases": {k: round(v, 4) for k, v in result.metrics.items()
                        if k.startswith("time/")},
         })
-        headline = (rate, words)
+        headline = (rate, words, rate / base_rate)
 
     detail_path = os.path.join(CACHE_DIR, "BENCH_DETAIL.json")
     with open(detail_path, "w") as f:
@@ -290,9 +352,13 @@ def main() -> int:
             "metric": "wordcount_words_per_sec_per_chip",
             "value": round(headline[0], 1),
             "unit": "words/sec",
-            "vs_baseline": round(headline[0] / base_rate, 3),
+            "vs_baseline": round(headline[2], 3),
             "headline_corpus_mb": BENCH_SIZES[-1],
+            "headline_method": "median of 3 alternating baseline/framework "
+                               "pairs" if headline_pairs else "best-of-runs "
+                               "vs up-front baseline",
             "cpu_baseline_words_per_sec": round(base_rate, 1),
+            "session_probes": probes,
             "per_size": per_size,
             "workloads": workloads,
         }, f, indent=1)
@@ -309,12 +375,48 @@ def main() -> int:
         "metric": "wordcount_words_per_sec_per_chip",
         "value": round(headline[0], 1),
         "unit": "words/sec",
-        "vs_baseline": round(headline[0] / base_rate, 3),
+        "vs_baseline": round(headline[2], 3),
         "headline_corpus_mb": BENCH_SIZES[-1],
         "workloads": wl_ratios,
         "detail_file": os.path.relpath(detail_path, REPO),
     }))
     return 0
+
+
+def _session_probes() -> dict:
+    """Fixed-work host and link probes, recorded in the artifact so a
+    reader can normalize ratios across sessions: the build host's
+    single-thread rate drifts ~±15% and the host->device link has been
+    measured anywhere from 26 MB/s to 1.2 GB/s for the same put
+    (benchmarks/RESULTS.md link-variance note)."""
+    probes: dict = {}
+    # host probe: a fixed pure-Python workload (~0.2s nominal) — the same
+    # interpreter work class as the reference-model baseline
+    t0 = time.perf_counter()
+    acc = 0
+    for i in range(2_000_000):
+        acc += i * i
+    probes["host_spin_s"] = round(time.perf_counter() - t0, 4)
+    probes["host_spin_work"] = "sum(i*i, i<2e6)"
+    # link probe: one 128MB device_put, fetch-forced
+    try:
+        import jax
+
+        mb = 128
+        buf = np.ones(mb << 20, np.uint8)
+        dev = jax.devices()[0]
+        jax.device_put(buf[:1 << 20], dev).block_until_ready()  # wake link
+        t0 = time.perf_counter()
+        jax.device_put(buf, dev).block_until_ready()
+        dt = time.perf_counter() - t0
+        probes["link_put_mb"] = mb
+        probes["link_put_s"] = round(dt, 4)
+        probes["link_put_mb_per_s"] = round(mb / dt, 1)
+        probes["device"] = str(dev.platform)
+        del buf
+    except Exception as e:  # cpu-only or tunnel-down hosts still bench
+        probes["link_probe_error"] = str(e)
+    return probes
 
 
 def _release_heap() -> None:
@@ -481,30 +583,37 @@ def _bench_workloads(run_job, JobConfig) -> dict:
     from map_oxidize_tpu.workloads.reference_model import wordcount_model
 
     rt_corpus = os.path.join(CACHE_DIR, "realtext_256mb.txt")
+    rt_ok = True
     if not os.path.isfile(rt_corpus):
-        make_realtext_corpus(rt_corpus, 256)
-    with open(rt_corpus, "rb") as f:
-        rt_slice = f.read(8 * 1024 * 1024)
-    rt_slice = rt_slice[: rt_slice.rfind(b"\n") + 1]
-    rt_slice_path = os.path.join(CACHE_DIR, "realtext_slice.txt")
-    with open(rt_slice_path, "wb") as f:
-        f.write(rt_slice)
-    t0 = time.perf_counter()
-    rt_counts = wordcount_model([rt_slice])
-    rt_base_rate = sum(rt_counts.values()) / (time.perf_counter() - t0)
-    sr = run_job(JobConfig(input_path=rt_slice_path, output_path="",
-                           backend="auto", metrics=False, top_k=TOP_K,
-                           num_shards=1), "wordcount")
-    rt_ok = (rt_base_rate > 0
-             and sr.top[:TOP_K] == top_k_model(rt_counts, TOP_K))
-    if not rt_ok:
-        # rt_base_rate == 0 means a degenerate corpus (text sources
-        # missing on this host) — skip the entry, keep measuring the rest
-        out["wordcount_realtext_error"] = (
-            "real-text corpus degenerate (no text sources found)"
-            if rt_base_rate <= 0
-            else "real-text top-k parity FAILED vs reference model")
-    del rt_counts, sr  # parity-model heap must not tax later timed runs
+        try:
+            make_realtext_corpus(rt_corpus, 256)
+        except RuntimeError as e:  # no prose sources in this image
+            out["wordcount_realtext_error"] = str(e)
+            rt_ok = False
+    if rt_ok:
+        with open(rt_corpus, "rb") as f:
+            rt_slice = f.read(8 * 1024 * 1024)
+        rt_slice = rt_slice[: rt_slice.rfind(b"\n") + 1]
+        rt_slice_path = os.path.join(CACHE_DIR, "realtext_slice.txt")
+        with open(rt_slice_path, "wb") as f:
+            f.write(rt_slice)
+        t0 = time.perf_counter()
+        rt_counts = wordcount_model([rt_slice])
+        rt_base_rate = sum(rt_counts.values()) / (time.perf_counter() - t0)
+        sr = run_job(JobConfig(input_path=rt_slice_path, output_path="",
+                               backend="auto", metrics=False, top_k=TOP_K,
+                               num_shards=1), "wordcount")
+        rt_ok = (rt_base_rate > 0
+                 and sr.top[:TOP_K] == top_k_model(rt_counts, TOP_K))
+        if not rt_ok:
+            # rt_base_rate == 0 means a degenerate corpus (text sources
+            # missing on this host) — skip the entry, keep measuring the
+            # rest
+            out["wordcount_realtext_error"] = (
+                "real-text corpus degenerate (no text sources found)"
+                if rt_base_rate <= 0
+                else "real-text top-k parity FAILED vs reference model")
+        del rt_counts, sr  # parity-model heap must not tax later timed runs
     if rt_ok:
         _release_heap()
         cfg = JobConfig(input_path=rt_corpus, output_path="",
@@ -710,6 +819,49 @@ def _bench_workloads(run_job, JobConfig) -> dict:
                 "precision": "f32(Precision.HIGHEST)",
             })
         out[f"kmeans_device_2m_d64_k256_{iters2}iter"] = entry
+
+        # --- bf16 variant (round-4 verdict #6): --kmeans-precision bf16
+        # runs each matmul as ONE native MXU pass (f32 accumulation via
+        # preferred_element_type) instead of HIGHEST's multi-pass f32
+        # emulation — the only fair basis for a bf16-peak MFU figure.
+        # Convergence-parity gate: the 100-iter bf16 trajectory must stay
+        # within bf16 rounding of the f32-HIGHEST centroids (same bound
+        # tests/test_kmeans.py pins on CPU); drift is reported either way.
+        bcfg = JobConfig(input_path=pts2_path, output_path="",
+                         backend="auto", metrics=True, kmeans_k=k2,
+                         kmeans_iters=iters2, mapper="device",
+                         kmeans_precision="bf16")
+        run_job(bcfg, "kmeans")  # warm/compile the bf16 program
+        rb, secs_b = best_of(lambda: run_job(bcfg, "kmeans"))
+        scale = float(np.abs(r.centroids).max())
+        drift = float(np.abs(rb.centroids - r.centroids).max())
+        drift_ok = drift <= 4 * 2.0**-8 * scale
+        rate_b = rb.metrics["records_in"] / secs_b
+        entry_b = {
+            "best_s": round(secs_b, 3),
+            "point_iters_per_sec": round(rate_b, 1),
+            "vs_baseline": round(rate_b / km2_base_rate, 3),
+            "cpu_baseline_point_iters_per_sec": round(km2_base_rate, 1),
+            "iters": int(rb.metrics["iters"]),
+            "max_drift_vs_f32": round(drift, 5),
+            "drift_bound": round(4 * 2.0**-8 * scale, 5),
+            "precision": "bf16 (native MXU, f32 accumulation)",
+        }
+        iter_sb = rb.metrics.get("time/iter_s")
+        if iter_sb:
+            flops = 4.0 * n2 * d2_ * k2 * iters2
+            peak = float(os.environ.get("MOXT_TPU_PEAK_FLOPS", 197e12))
+            entry_b.update({
+                "transfer_s": rb.metrics.get("time/transfer_s"),
+                "iter_s": iter_sb,
+                "flops_per_sec": round(flops / iter_sb, 1),
+                "mfu_pct": round(100 * flops / iter_sb / peak, 2),
+            })
+        if not drift_ok:
+            out["kmeans_bf16_error"] = (
+                f"bf16 drift {drift:.4f} exceeds rounding bound "
+                f"{4 * 2.0**-8 * scale:.4f} vs f32-HIGHEST")
+        out[f"kmeans_device_bf16_2m_d64_k256_{iters2}iter"] = entry_b
     return out
 
 

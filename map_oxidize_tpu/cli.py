@@ -83,6 +83,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="k-means cluster count (init: first k points)")
     p.add_argument("--kmeans-iters", type=int, default=1,
                    help="k-means iterations")
+    p.add_argument("--kmeans-precision", choices=["highest", "bf16"],
+                   default="highest",
+                   help="device-path matmul precision: f32-emulating "
+                        "HIGHEST (oracle parity) or native single-pass "
+                        "bf16 MXU matmuls with f32 accumulation")
     p.add_argument("--dist-coordinator", default="",
                    help="multi-host: coordination address host:port (same "
                         "on every process); enables jax.distributed")
@@ -134,6 +139,7 @@ def config_from_args(args: argparse.Namespace) -> JobConfig:
         hll_precision=args.hll_precision,
         kmeans_k=args.kmeans_k,
         kmeans_iters=args.kmeans_iters,
+        kmeans_precision=args.kmeans_precision,
     ).validate()
 
 
@@ -154,14 +160,6 @@ def main(argv: list[str] | None = None) -> int:
                      "--checkpoint-dir (there are no intermediates: map "
                      "outputs stay on device)")
     if config.dist_coordinator:
-        if config.output_path:
-            _log.info("distributed mode writes no output file (full key "
-                      "strings stay per-process); --output is ignored")
-        if args.workload == "kmeans":
-            print("error: distributed mode supports wordcount/bigram/"
-                  "invertedindex/distinct (kmeans scales multi-chip via "
-                  "--num-shards on one controller)", file=sys.stderr)
-            return 2
         from map_oxidize_tpu.parallel.distributed import (
             init_distributed,
             run_distributed_job,
@@ -170,6 +168,25 @@ def main(argv: list[str] | None = None) -> int:
         init_distributed(config.dist_coordinator,
                          config.dist_num_processes, config.dist_process_id)
         r = run_distributed_job(config, args.workload)
+        if args.workload == "kmeans":
+            c = r.centroids
+            print(f"k-means: {c.shape[0]} centroids, dim {c.shape[1]}, "
+                  f"{config.kmeans_iters} iterations "
+                  f"({config.dist_num_processes} processes)")
+            return 0
+        if config.output_path and args.workload != "distinct":
+            from map_oxidize_tpu.parallel.distributed import (
+                partition_output_path,
+            )
+
+            _log.info(
+                "process %d wrote its hash partition to %s (concatenate "
+                "the %d parts and sort for the single-file artifact)",
+                config.dist_process_id,
+                partition_output_path(config.output_path,
+                                      config.dist_process_id,
+                                      config.dist_num_processes),
+                config.dist_num_processes)
         if args.workload == "distinct":
             print(f"distinct tokens ~ {r.estimate:,.0f} "
                   f"({config.dist_num_processes} processes)")

@@ -94,6 +94,12 @@ class JobConfig:
     kmeans_k: int = 16
     #: k-means: iterations to run
     kmeans_iters: int = 1
+    #: k-means device-path matmul precision: "highest" (f32 oracle-parity,
+    #: the MXU emulates f32 with multiple bf16 passes) or "bf16" (native
+    #: single-pass MXU matmuls with f32 accumulation — the chip's design
+    #: rate; assignment boundaries can shift within bf16 rounding).  The
+    #: streamed (host-assign) path is NumPy f32 and ignores this.
+    kmeans_precision: str = "highest"
     #: collect engines: resident-row cap before the host collect-reduce
     #: switches to its disk-bucket spill (hash-only count jobs) or the
     #: engines abort (explicit-value / pair jobs).  0 = engine defaults
@@ -126,6 +132,9 @@ class JobConfig:
             raise ValueError("top_k and num_map_workers must be positive")
         if self.kmeans_k <= 0 or self.kmeans_iters <= 0:
             raise ValueError("kmeans_k and kmeans_iters must be positive")
+        if self.kmeans_precision not in ("highest", "bf16"):
+            raise ValueError(f"kmeans_precision must be highest|bf16, "
+                             f"got {self.kmeans_precision!r}")
         if self.collect_max_rows < 0:
             raise ValueError("collect_max_rows must be >= 0 (0 = default)")
         from map_oxidize_tpu.workloads.distinct import HLL_P_MIN, HLL_P_MAX

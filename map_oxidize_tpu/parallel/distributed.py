@@ -27,8 +27,13 @@ Key *strings* live in per-process dictionaries; the global report for the
 top-k winners gathers each process's resolutions THROUGH the mesh
 (:func:`gather_strings`: two ``process_allgather`` rounds — lens, then
 byte planes — with a cross-process collision byte-check), so the CLI
-prints words, not hashes.  Full-corpus string output stays per-process
-by design: only winners need global strings.
+prints words, not hashes.  With ``--output``, every process writes its
+hash partition (``h % P == proc``) as ``<output>.part<p>of<P>`` in the
+single-process writer's exact row format — concatenating the parts and
+sorting yields the byte-identical ``final_result.txt`` (the reference's
+primary artifact, ``main.rs:170-182``); only the partition's *misses*
+(keys this process never mapped itself) travel through one extra
+gather_strings collective.
 
 The reference has no multi-process anything (single tokio process,
 ``/root/reference/src/main.rs``); this is the capability the blueprint's
@@ -305,16 +310,19 @@ def gather_strings(hashes: "list[int]", dictionary) -> "dict[int, bytes]":
         return {}
     d = dictionary.materialized()
     local = [d.get(h) for h in hashes]
-    lens = np.array([0 if b is None else len(b) for b in local], np.int32)
+    # presence is tracked separately from length (len sentinel -1 =
+    # unknown-here), so a zero-length key resolves to b"" instead of
+    # silently reporting unresolvable
+    lens = np.array([-1 if b is None else len(b) for b in local], np.int32)
     all_lens = np.asarray(multihost_utils.process_allgather(lens))
     if all_lens.ndim == 1:  # single process: allgather returns (k,)
         all_lens = all_lens[None]
     maxlen = int(all_lens.max())
-    if maxlen == 0:
+    if maxlen < 0:
         return {}
-    buf = np.zeros((k, maxlen), np.uint8)
+    buf = np.zeros((k, max(maxlen, 1)), np.uint8)
     for i, b in enumerate(local):
-        if b:
+        if b is not None and b:
             buf[i, :len(b)] = np.frombuffer(b, np.uint8)
     all_buf = np.asarray(multihost_utils.process_allgather(buf))
     if all_buf.ndim == 2:
@@ -323,7 +331,7 @@ def gather_strings(hashes: "list[int]", dictionary) -> "dict[int, bytes]":
     for i, h in enumerate(hashes):
         for p in range(all_lens.shape[0]):
             ln = int(all_lens[p, i])
-            if not ln:
+            if ln < 0:
                 continue
             b = bytes(all_buf[p, i, :ln])
             prev = out.get(h)
@@ -333,6 +341,71 @@ def gather_strings(hashes: "list[int]", dictionary) -> "dict[int, bytes]":
                     f"both hash to {h:#x}")
             out[h] = b
     return out
+
+
+def _allgather_union(local: np.ndarray) -> np.ndarray:
+    """Global sorted-unique union of each process's u64 hash list (two
+    allgather rounds: counts, then zero-padded planes).  The result is
+    identical on every process, so it can feed :func:`gather_strings`
+    (a collective that requires the same hash list everywhere).
+
+    Hashes travel as (2, n) uint32 hi/lo planes: with jax's default
+    x64-disabled config, ``process_allgather`` silently downcasts int64
+    input to int32 — a 64-bit hash shipped directly loses its top half
+    (caught driving the CLI end-to-end, round 5)."""
+    from jax.experimental import multihost_utils
+
+    from map_oxidize_tpu.ops.hashing import join_u64, split_u64
+
+    def _ag(a):
+        g = np.asarray(multihost_utils.process_allgather(a))
+        return g[None] if g.ndim == a.ndim else g
+
+    local = np.asarray(local, np.uint64)
+    all_n = _ag(np.array([local.shape[0]], np.int32)).reshape(-1)
+    cap = int(all_n.max()) if all_n.size else 0
+    if cap == 0:
+        return np.empty(0, np.uint64)
+    pad = np.zeros((2, cap), np.uint32)
+    hi, lo = split_u64(local)
+    pad[0, :local.shape[0]] = hi
+    pad[1, :local.shape[0]] = lo
+    planes = _ag(pad)
+    parts = [join_u64(planes[i, 0, :int(all_n[i])],
+                      planes[i, 1, :int(all_n[i])])
+             for i in range(planes.shape[0])]
+    return np.unique(np.concatenate(parts))
+
+
+def partition_strings(hashes, dictionary, proc: int, n_proc: int
+                      ) -> "dict[int, bytes]":
+    """Resolve key bytes for THIS process's hash partition
+    (``h % n_proc == proc``) of ``hashes``.  Local dictionary first; the
+    union of every process's misses resolves through one
+    :func:`gather_strings` round.  Every process must call this — it is a
+    collective — and every counted key was mapped by *some* process, so an
+    unresolvable key is an engine bug and raises."""
+    owned = [int(h) for h in hashes if int(h) % n_proc == proc]
+    d = dictionary.materialized()
+    missing = np.array([h for h in owned if h not in d], np.uint64)
+    gathered = gather_strings(
+        [int(h) for h in _allgather_union(missing)], dictionary)
+    out: dict[int, bytes] = {}
+    for h in owned:
+        b = d.get(h)
+        if b is None:
+            b = gathered.get(h)
+        if b is None:
+            raise RuntimeError(
+                f"no process could resolve key {h:#x} — its mapper "
+                "dictionary should have recorded it")
+        out[h] = b
+    return out
+
+
+def partition_output_path(output_path: str, proc: int, n_proc: int) -> str:
+    """``<output>.part<p>of<P>`` — self-describing, no manifest needed."""
+    return f"{output_path}.part{proc}of{n_proc}"
 
 
 @dataclass
@@ -347,6 +420,7 @@ class DistributedResult:
     records: int                      # THIS process's mapped records
     n_pairs: int = 0                  # invertedindex only
     estimate: float = 0.0             # distinct only
+    centroids: "np.ndarray | None" = None  # kmeans only (replicated)
     flag_rounds: int = 0              # lockstep psum rounds paid
     flag_s: float = 0.0               # ... and their total wall-clock
     resumed_chunks: int = 0           # chunks replayed from checkpoint
@@ -402,6 +476,8 @@ def run_distributed_job(config: JobConfig, workload: str
     config.validate()
     if workload == "distinct":
         return _run_distributed_distinct(config)
+    if workload == "kmeans":
+        return _run_distributed_kmeans(config)
     use_native = resolve_mapper(config, workload) == "native"
     doc_mode = workload == "invertedindex"
     if workload == "wordcount":
@@ -531,11 +607,26 @@ def run_distributed_job(config: JobConfig, workload: str
         else:
             uniq = np.empty(0, np.uint64)
             df = np.empty(0, np.int64)
+            bounds = np.empty(0, np.int64)
         order = np.lexsort((uniq, -df))[:config.top_k]
         t_hashes = uniq[order].tolist()
         words = gather_strings(t_hashes, dictionary)
         top = [(h, words.get(h), int(df[order][j]))
                for j, h in enumerate(t_hashes)]
+        if config.output_path:
+            from map_oxidize_tpu.io.writer import write_postings
+
+            names = partition_strings(uniq.tolist(), dictionary,
+                                      engine.proc, P_)
+            ends = np.append(bounds, keys.shape[0])
+            postings = {
+                names[int(h)]: np.sort(
+                    docs[ends[j]:ends[j + 1]]).tolist()
+                for j, h in enumerate(uniq.tolist())
+                if int(h) % P_ == engine.proc}
+            write_postings(
+                partition_output_path(config.output_path, engine.proc, P_),
+                postings)
         result = DistributedResult(
             counts=None, top=top, n_keys=int(uniq.shape[0]),
             records=records, n_pairs=int(keys.shape[0]),
@@ -562,6 +653,14 @@ def run_distributed_job(config: JobConfig, workload: str
         words = gather_strings(t_hashes, dictionary)
         top = [(h, words.get(h), c)
                for h, c in zip(t_hashes, t_vals[tlive].tolist())]
+        if config.output_path:
+            from map_oxidize_tpu.io.writer import write_final_result
+
+            names = partition_strings(list(counts), dictionary,
+                                      engine.proc, P_)
+            write_final_result(
+                partition_output_path(config.output_path, engine.proc, P_),
+                ((b, counts[h]) for h, b in names.items()))
         result = DistributedResult(
             counts=counts, top=top, n_keys=n, records=records,
             flag_rounds=flag_rounds, flag_s=flag_s,
@@ -606,8 +705,89 @@ def _run_distributed_distinct(config: JobConfig) -> DistributedResult:
         all_regs = all_regs[None]
     merged = all_regs.max(axis=0).astype(np.int32)
     est = hll_estimate(merged)
+    if config.output_path and proc == 0:
+        # merged registers are replicated, so one writer suffices and the
+        # file is byte-identical to the single-process driver's
+        from map_oxidize_tpu.workloads.distinct import write_distinct_output
+
+        write_distinct_output(config.output_path, merged, float(est), p)
     return DistributedResult(counts=None, top=[], n_keys=0,
                              records=records, estimate=float(est))
+
+
+def _run_distributed_kmeans(config: JobConfig) -> DistributedResult:
+    """Multi-process k-means: the SAME jitted psum iteration the
+    single-controller sharded fit runs (:func:`parallel.kmeans.make_fit_fn`
+    — one XLA program, so the paths cannot drift), with the points array
+    assembled from per-process row blocks via
+    ``make_array_from_process_local_data``.  Each process loads ONLY its
+    contiguous row slice of the ``.npy`` (mmap — the input must be visible
+    to every host, e.g. shared storage on a pod); centroids stay
+    replicated, and the one ``(k, d+1)`` psum per iteration is the only
+    cross-process traffic.  Returns replicated centroids; process 0 writes
+    ``--output`` (identical on every process by construction)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from map_oxidize_tpu.parallel.kmeans import make_fit_fn
+    from map_oxidize_tpu.parallel.mesh import SHARD_AXIS, make_mesh
+
+    proc = jax.process_index()
+    n_proc = jax.process_count()
+    if config.checkpoint_dir:
+        _log.warning("--checkpoint-dir has no effect on distributed "
+                     "kmeans yet: centroids are replicated and iterations "
+                     "restart cheaply relative to the points load")
+    pts = np.load(config.input_path, mmap_mode="r")
+    if pts.ndim != 2:
+        raise ValueError(f"k-means input must be (n, d); got {pts.shape}")
+    n, d = pts.shape
+    k = config.kmeans_k
+    if n < k:
+        raise ValueError(
+            f"k-means needs at least kmeans_k={k} points; input has {n}")
+    # deterministic init: first k points (same as the single-process driver)
+    centroids = np.asarray(pts[:k], np.float32)
+
+    mesh = make_mesh(config.num_shards, config.backend)
+    S = mesh.shape[SHARD_AXIS]
+    if S % n_proc:
+        raise ValueError(f"shard count {S} must divide by process count "
+                         f"{n_proc}")
+    # global row padding to a multiple of S (zero-weight rows never move a
+    # centroid), then contiguous per-process blocks of n_pad/P rows — the
+    # rows this process's mesh slice addresses
+    n_pad = -(-n // S) * S
+    block = n_pad // n_proc
+    lo_row, hi_row = proc * block, (proc + 1) * block
+    local = np.zeros((block, d), np.float32)
+    take = max(0, min(hi_row, n) - lo_row)
+    if take:
+        local[:take] = pts[lo_row:lo_row + take]
+    w_local = np.zeros(block, np.float32)
+    w_local[:take] = 1.0
+
+    row = NamedSharding(mesh, P(SHARD_AXIS))
+    p_dev = jax.make_array_from_process_local_data(row, local, (n_pad, d))
+    w_dev = jax.make_array_from_process_local_data(row, w_local, (n_pad,))
+    fit_fn = make_fit_fn(mesh, k, d, config.kmeans_iters,
+                         config.kmeans_precision)
+    rep = jax.jit(lambda x: x, out_shardings=NamedSharding(mesh, P()))
+    out = np.asarray(rep(fit_fn(p_dev, w_dev,
+                                jax.device_put(centroids,
+                                               NamedSharding(mesh, P())))))
+    if config.output_path and proc == 0:
+        import os
+
+        tmp = f"{config.output_path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            np.save(f, out)
+        os.replace(tmp, config.output_path)
+    _log.info("distributed kmeans: %d processes, %d points, k=%d, %d "
+              "iterations", n_proc, n, k, config.kmeans_iters)
+    return DistributedResult(counts=None, top=[], n_keys=0,
+                             records=int(take) * config.kmeans_iters,
+                             centroids=out)
 
 
 def run_distributed_wordcount(config: JobConfig, workload: str = "wordcount"):

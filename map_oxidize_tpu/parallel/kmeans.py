@@ -26,9 +26,42 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from map_oxidize_tpu.parallel.mesh import SHARD_AXIS, make_mesh
 
 
+def make_fit_fn(mesh, k: int, d: int, loop_iters: int,
+                precision: str = "highest"):
+    """The jitted sharded iteration program: per-shard assign (distance
+    matmul) + one-hot partial sums (both from
+    :func:`workloads.kmeans.assign_and_sum` — the single-device step's
+    exact numerics, including the ``--kmeans-precision`` bf16 mode),
+    joined by ONE ``(k, d+1)`` psum per iteration.  Shared verbatim by
+    the single-controller sharded fit and the multi-process runner (same
+    XLA program, different array assembly), so the paths cannot drift."""
+    from map_oxidize_tpu.workloads.kmeans import assign_and_sum
+
+    def fit(p, w, c):
+        """Per-shard body: p, w are this shard's block; c is replicated."""
+
+        def step(_, c):
+            sums, counts = assign_and_sum(p, c, k, precision, w)
+            # ONE collective per iteration: the (k, d+1) partials
+            joined = lax.psum(
+                jnp.concatenate([sums, counts[:, None]], axis=1), SHARD_AXIS)
+            sums, counts = joined[:, :d], joined[:, d]
+            return jnp.where(counts[:, None] > 0,
+                             sums / jnp.maximum(counts[:, None], 1.0), c)
+
+        return lax.fori_loop(0, loop_iters, step, c)
+
+    return jax.jit(jax.shard_map(
+        fit, mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P()),
+        out_specs=P(),
+    ))
+
+
 def kmeans_fit_sharded(points, centroids, iters: int = 1, mesh=None,
                        num_shards: int = 0, backend: str = "auto",
-                       on_iter=None, timings: dict | None = None):
+                       on_iter=None, timings: dict | None = None,
+                       precision: str = "highest"):
     """Run ``iters`` k-means iterations with points sharded over the mesh.
 
     ``points``: host ``(n, d)`` float32 (rows pad to a multiple of the shard
@@ -59,32 +92,8 @@ def kmeans_fit_sharded(points, centroids, iters: int = 1, mesh=None,
     weights = np.zeros(n_pad, np.float32)
     weights[:n] = 1.0
 
-    def fit(p, w, c):
-        """Per-shard body: p, w are this shard's block; c is replicated."""
-
-        def step(_, c):
-            # HIGHEST precision: bf16 MXU default moves assignment
-            # boundaries enough to diverge from the f32 oracle
-            d2 = (-2.0 * jnp.dot(p, c.T, precision=lax.Precision.HIGHEST)
-                  + (c * c).sum(1))
-            cid = jnp.argmin(d2, axis=1)
-            onehot = jax.nn.one_hot(cid, k, dtype=p.dtype) * w[:, None]
-            sums = jnp.dot(onehot.T, p, precision=lax.Precision.HIGHEST)
-            counts = onehot.sum(0)
-            # ONE collective per iteration: the (k, d+1) partials
-            joined = lax.psum(
-                jnp.concatenate([sums, counts[:, None]], axis=1), SHARD_AXIS)
-            sums, counts = joined[:, :d], joined[:, d]
-            return jnp.where(counts[:, None] > 0,
-                             sums / jnp.maximum(counts[:, None], 1.0), c)
-
-        return lax.fori_loop(0, 1 if on_iter is not None else iters, step, c)
-
-    fit_fn = jax.jit(jax.shard_map(
-        fit, mesh=mesh,
-        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P()),
-        out_specs=P(),
-    ))
+    fit_fn = make_fit_fn(mesh, k, d,
+                         1 if on_iter is not None else iters, precision)
     row = NamedSharding(mesh, P(SHARD_AXIS))
     rep = NamedSharding(mesh, P())
     t0 = time.perf_counter()

@@ -551,14 +551,32 @@ class KMeansResult:
                 f"dim {self.centroids.shape[1]}")
 
 
-#: mapper='auto' picks the HBM-resident fit when the whole working set
-#: fits comfortably on one device: points (n*d*4) PLUS the (n, k)
-#: distance and one-hot intermediates (n*k*4 each) the device step
-#: materializes — i.e. 4*n*(d + 2k) bytes against this budget (v5-lite-
-#: class chips carry 16GB HBM; 8GB leaves headroom for XLA's own
-#: buffers and the fori_loop's double-buffered carries).  Beyond it, the
-#: job streams — the only option at that scale.
+#: fallback fit budget when the device doesn't report its memory
+#: (v5-lite-class chips carry 16GB HBM; 8GB leaves headroom for XLA's
+#: own buffers and the fori_loop's double-buffered carries)
 _KMEANS_DEVICE_FIT_BYTES = 8 << 30
+
+
+def _kmeans_device_fit_bytes(backend: str) -> int:
+    """mapper='auto' picks the HBM-resident fit when the whole working set
+    fits comfortably on one device: points (n*d*4) PLUS the (n, k)
+    distance and one-hot intermediates (n*k*4 each) the device step
+    materializes — i.e. 4*n*(d + 2k) bytes against this budget.  The
+    budget is HALF the device's reported memory (headroom for XLA's own
+    buffers and the fori_loop's double-buffered carries), falling back to
+    8GB when the runtime doesn't expose memory stats (advisor r4: the
+    old hardcoded 8GB assumed a 16GB chip and could OOM smaller ones).
+    Beyond it, the job streams — the only option at that scale."""
+    try:
+        from map_oxidize_tpu.runtime.engine import pick_device
+
+        stats = pick_device(backend).memory_stats()
+        total = int(stats.get("bytes_limit", 0))
+        if total > 0:
+            return total // 2
+    except Exception:
+        pass  # CPU backends and some plugins expose no memory stats
+    return _KMEANS_DEVICE_FIT_BYTES
 
 
 def _adopt_checkpoint_kmeans_mode(config: JobConfig,
@@ -632,9 +650,9 @@ def run_kmeans_job(config: JobConfig, centroids: np.ndarray | None = None
         device_mode = True
     elif config.mapper == "auto":
         # whole device working set: points + the (n, k) distance/one-hot
-        # intermediates (see _KMEANS_DEVICE_FIT_BYTES)
+        # intermediates (see _kmeans_device_fit_bytes)
         device_mode = (4 * int(n) * (int(d) + 2 * config.kmeans_k)
-                       <= _KMEANS_DEVICE_FIT_BYTES)
+                       <= _kmeans_device_fit_bytes(config.backend))
         if config.checkpoint_dir:
             # an existing snapshot's mode wins over the heuristic: resume
             # must continue the trajectory it was cut from
@@ -647,6 +665,7 @@ def run_kmeans_job(config: JobConfig, centroids: np.ndarray | None = None
                 CheckpointStore.job_meta(config, "kmeans", extra={
                     "kmeans_k": config.kmeans_k,
                     "kmeans_backend": config.backend,
+                    "kmeans_precision": config.kmeans_precision,
                     "kmeans_init": hashlib.sha256(
                         centroids.tobytes()).hexdigest()[:16],
                 }))
@@ -684,6 +703,8 @@ def run_kmeans_job(config: JobConfig, centroids: np.ndarray | None = None
                 # backend changes float accumulation order (CPU XLA vs MXU)
                 # exactly like mode/shards do, so it is identity too
                 "kmeans_backend": config.backend,
+                # precision moves assignment boundaries — identity as well
+                "kmeans_precision": config.kmeans_precision,
                 # the digest pins the INITIAL centroids: a caller-provided
                 # init different from the snapshot's trajectory must
                 # invalidate, not be silently overridden
@@ -721,7 +742,7 @@ def run_kmeans_job(config: JobConfig, centroids: np.ndarray | None = None
                     np.asarray(pts, np.float32), centroids,
                     iters=remaining, num_shards=config.num_shards,
                     backend=config.backend, on_iter=on_iter,
-                    timings=timings)
+                    timings=timings, precision=config.kmeans_precision)
                 for tk, tv in timings.items():
                     metrics.set(f"time/{tk}", round(tv, 4))
             else:
@@ -734,7 +755,7 @@ def run_kmeans_job(config: JobConfig, centroids: np.ndarray | None = None
                     np.asarray(pts, np.float32), centroids,
                     iters=remaining,
                     device=pick_device(config.backend), on_iter=on_iter,
-                    timings=timings)
+                    timings=timings, precision=config.kmeans_precision)
                 for tk, tv in timings.items():
                     metrics.set(f"time/{tk}", round(tv, 4))
         else:
@@ -903,22 +924,11 @@ def run_distinct_job(config: JobConfig) -> DistinctResult:
 
     with metrics.phase("write"):
         if config.output_path:
-            # .npy: the raw registers — the mergeable artifact (np.maximum
-            # of two runs' registers estimates the union).  Anything else:
-            # a deterministic text summary.  Atomic like every writer.
-            import os
+            from map_oxidize_tpu.workloads.distinct import (
+                write_distinct_output,
+            )
 
-            tmp = f"{config.output_path}.tmp.{os.getpid()}"
-            if config.output_path.endswith(".npy"):
-                with open(tmp, "wb") as f:
-                    np.save(f, regs)
-            else:
-                with open(tmp, "w") as f:
-                    f.write(f"estimate\t{estimate:.1f}\n"
-                            f"precision\t{p}\n"
-                            f"registers_filled\t"
-                            f"{int(np.count_nonzero(regs))}\n")
-            os.replace(tmp, config.output_path)
+            write_distinct_output(config.output_path, regs, estimate, p)
 
     if ckpt is not None:
         ckpt.finish(config.keep_intermediates)

@@ -304,14 +304,22 @@ class HostCollectReduceEngine:
 
     def finalize(self):
         """Engine contract: ``(hi, lo, vals, n_unique)``; no padding rows —
-        every returned row is live."""
+        every returned row is live.
+
+        ``vals`` is normally ``value_dtype`` (int32), but a beyond-RAM sum
+        job whose hottest key exceeds ``value_dtype``'s range returns
+        int64 instead of silently wrapping (logged when it happens) —
+        consumers that pack values must check ``vals.dtype``, not assume
+        the configured dtype."""
         keys, vals = self._reduce()
         hi, lo = split_u64(keys)
         return hi, lo, vals, int(keys.shape[0])
 
     def top_k(self, k: int):
         """(hi_k, lo_k, vals_k, n_unique) — count-descending, deterministic
-        key-ascending tie-break, mirroring the device engines."""
+        key-ascending tie-break, mirroring the device engines.  Like
+        :meth:`finalize`, ``vals_k`` widens to int64 when a count
+        overflows ``value_dtype`` (beyond-RAM hot keys)."""
         keys, vals = self._reduce()
         n = int(keys.shape[0])
         if n == 0:

@@ -155,6 +155,27 @@ def distinct_model(chunks, tokenizer: str = "ascii") -> int:
     return len(seen)
 
 
+def write_distinct_output(path: str, regs: np.ndarray, estimate: float,
+                          p: int) -> None:
+    """Atomic distinct-result writer, shared by the single-process driver
+    and the distributed runner (registers max-merge exactly, so both write
+    byte-identical files).  ``.npy``: the raw registers — the mergeable
+    artifact (np.maximum of two runs' registers estimates the union).
+    Anything else: a deterministic text summary."""
+    import os
+
+    tmp = f"{path}.tmp.{os.getpid()}"
+    if path.endswith(".npy"):
+        with open(tmp, "wb") as f:
+            np.save(f, regs)
+    else:
+        with open(tmp, "w") as f:
+            f.write(f"estimate\t{estimate:.1f}\n"
+                    f"precision\t{p}\n"
+                    f"registers_filled\t{int(np.count_nonzero(regs))}\n")
+    os.replace(tmp, path)
+
+
 def make_distinct(tokenizer: str = "ascii", use_native: bool = True,
                   p: int = 14):
     return DistinctMapper(tokenizer, use_native, p), MaxReducer()

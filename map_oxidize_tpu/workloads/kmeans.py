@@ -139,7 +139,8 @@ def kmeans_model(points: np.ndarray, centroids: np.ndarray) -> np.ndarray:
 
 
 def kmeans_fit_device(points, centroids, iters: int = 1, device=None,
-                      on_iter=None, timings: dict | None = None):
+                      on_iter=None, timings: dict | None = None,
+                      precision: str = "highest"):
     """HBM-resident k-means: points transfer once, ``iters`` iterations run
     entirely on device (distance matmul + one-hot matmul partial sums — both
     MXU work).  Returns the final centroids as NumPy.
@@ -173,13 +174,13 @@ def kmeans_fit_device(points, centroids, iters: int = 1, device=None,
     if on_iter is None:
         # asarray forces the chain (block_until_ready is not reliable for
         # computed results on the remote-attach platform)
-        out = np.asarray(_kmeans_fit(c_dev, p_dev, k, iters))
+        out = np.asarray(_kmeans_fit(c_dev, p_dev, k, iters, precision))
         if timings is not None:
             timings["iter_s"] = time.perf_counter() - t0
         return out
     c = c_dev
     for i in range(iters):
-        c = _kmeans_step(c, p_dev, k)
+        c = _kmeans_step(c, p_dev, k, precision)
         on_iter(i + 1, np.asarray(c))
     # no iter_s here: this loop interleaves per-iteration readback and the
     # caller's snapshot I/O, so it is NOT the compute-bound region the
@@ -187,21 +188,55 @@ def kmeans_fit_device(points, centroids, iters: int = 1, device=None,
     return np.asarray(c)
 
 
-def _kmeans_step_impl(c, p, k: int):
+def assign_and_sum(p, c, k: int, precision: str = "highest", w=None):
+    """Shared numerics of one k-means iteration (single-device AND sharded
+    steps import this, so the two paths cannot drift): distance matmul ->
+    argmin assignment -> one-hot partial-sum matmul.  Returns
+    ``(sums (k, d), counts (k,))`` — per-shard partials in the sharded
+    case (``w``: 0/1 row weights so padding never moves a centroid).
+
+    ``precision``:
+
+    * ``"highest"`` — f32 operands, ``Precision.HIGHEST`` matmuls (the
+      MXU emulates f32 with multiple bf16 passes; the oracle-parity mode).
+    * ``"bf16"`` — matmul operands cast to bfloat16 with f32 accumulation
+      (``preferred_element_type``): ONE native MXU pass per matmul, the
+      rate the chip is built for.  One-hot/weight values are 0/1 (exact
+      in bf16) and accumulation stays f32, so only the distance ranking
+      and each point's bf16 rounding perturb the result — bounded by the
+      convergence-parity test and the bench drift gate.
+    """
     import jax
     import jax.numpy as jnp
     from jax import lax
 
-    # HIGHEST precision: the TPU MXU's default bf16 matmul moves
-    # assignment boundaries enough to diverge from the f32 oracle; the
-    # distance matmul is tiny next to the transfer this path amortizes
-    d2 = (-2.0 * jnp.dot(p, c.T, precision=lax.Precision.HIGHEST)
-          + (c * c).sum(1))
+    if precision == "bf16":
+        pm, cm = p.astype(jnp.bfloat16), c.astype(jnp.bfloat16)
+
+        def dot(a, b):
+            return jnp.dot(a, b, preferred_element_type=jnp.float32)
+    elif precision == "highest":
+        pm, cm = p, c
+
+        def dot(a, b):
+            return jnp.dot(a, b, precision=lax.Precision.HIGHEST)
+    else:
+        raise ValueError(f"unknown kmeans precision {precision!r}")
+    # squared-norm term stays f32 in both modes (cheap, no matmul)
+    d2 = -2.0 * dot(pm, cm.T) + (c * c).sum(1)
     cid = jnp.argmin(d2, axis=1)
     onehot = jax.nn.one_hot(cid, k, dtype=p.dtype)           # (n, k)
-    sums = jnp.dot(onehot.T, p,
-                   precision=lax.Precision.HIGHEST)           # (k, d) on MXU
+    if w is not None:
+        onehot = onehot * w[:, None]
+    sums = dot(onehot.astype(pm.dtype).T, pm)                # (k, d) on MXU
     counts = onehot.sum(0)
+    return sums, counts
+
+
+def _kmeans_step_impl(c, p, k: int, precision: str = "highest"):
+    import jax.numpy as jnp
+
+    sums, counts = assign_and_sum(p, c, k, precision)
     return jnp.where(counts[:, None] > 0,
                      sums / jnp.maximum(counts[:, None], 1.0), c)
 
@@ -217,12 +252,13 @@ def _make_jitted():
     import jax
     from jax import lax
 
-    step = jax.jit(_kmeans_step_impl, static_argnums=(2,))
+    step = jax.jit(_kmeans_step_impl, static_argnums=(2, 3))
 
-    @functools.partial(jax.jit, static_argnums=(2, 3))
-    def fit(c, p, k, iters):
+    @functools.partial(jax.jit, static_argnums=(2, 3, 4))
+    def fit(c, p, k, iters, precision):
         return lax.fori_loop(
-            0, iters, lambda _, cc: _kmeans_step_impl(cc, p, k), c)
+            0, iters,
+            lambda _, cc: _kmeans_step_impl(cc, p, k, precision), c)
 
     return step, fit
 
@@ -234,16 +270,16 @@ class _Lazy:
     fit = None
 
 
-def _kmeans_step(c, p, k):
+def _kmeans_step(c, p, k, precision="highest"):
     if _Lazy.step is None:
         _Lazy.step, _Lazy.fit = _make_jitted()
-    return _Lazy.step(c, p, k)
+    return _Lazy.step(c, p, k, precision)
 
 
-def _kmeans_fit(c, p, k, iters):
+def _kmeans_fit(c, p, k, iters, precision="highest"):
     if _Lazy.fit is None:
         _Lazy.step, _Lazy.fit = _make_jitted()
-    return _Lazy.fit(c, p, k, iters)
+    return _Lazy.fit(c, p, k, iters, precision)
 
 
 def make_kmeans(centroids: np.ndarray):

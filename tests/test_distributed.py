@@ -25,6 +25,7 @@ import json, os, sys
 pid = int(sys.argv[1]); nproc = int(sys.argv[2]); port = sys.argv[3]
 corpus = sys.argv[4]; out_path = sys.argv[5]; workload = sys.argv[6]
 ckpt = sys.argv[7] if len(sys.argv) > 7 and sys.argv[7] != "-" else None
+final = sys.argv[8] if len(sys.argv) > 8 and sys.argv[8] != "-" else ""
 from map_oxidize_tpu.config import JobConfig
 from map_oxidize_tpu.parallel.distributed import (
     init_distributed, run_distributed_job)
@@ -44,10 +45,11 @@ if die_after and pid == 1:
             os._exit(3)
     CheckpointStore.save = dying_save
 
-cfg = JobConfig(input_path=corpus, output_path="", chunk_bytes=4096,
+cfg = JobConfig(input_path=corpus, output_path=final, chunk_bytes=4096,
                 batch_size=1 << 12, key_capacity=1 << 12, top_k=5,
                 metrics=False, checkpoint_dir=ckpt,
-                keep_intermediates=bool(ckpt))
+                keep_intermediates=bool(ckpt),
+                kmeans_k=4, kmeans_iters=3)
 r = run_distributed_job(cfg, workload)
 payload = {
     "n_keys": r.n_keys, "n_pairs": r.n_pairs, "records": r.records,
@@ -57,6 +59,7 @@ payload = {
              None if w is None else w.decode("utf-8"), c]
             for h, w, c in r.top],
     "counts": {str(k): v for k, v in (r.counts or {}).items()},
+    "centroids": None if r.centroids is None else r.centroids.tolist(),
 }
 with open(out_path, "w") as f:
     json.dump(payload, f, sort_keys=True)
@@ -94,7 +97,7 @@ def _env(devices: int):
 
 
 def _launch(tmp_path, corpus, nproc, workload, devices=None, ckpt=None,
-            extra_env=None, expect_fail=False, timeout=420):
+            extra_env=None, expect_fail=False, timeout=420, final=None):
     """Run ``nproc`` child processes; returns (payload list, logs).  The
     free-port probe is inherently racy (bind/close/reuse), so the whole
     launch retries once on a fresh port.  ``devices`` is the PER-PROCESS
@@ -108,7 +111,7 @@ def _launch(tmp_path, corpus, nproc, workload, devices=None, ckpt=None,
         port = _free_port()
         procs = [subprocess.Popen(
             [sys.executable, "-c", _CHILD, str(i), str(nproc), str(port),
-             str(corpus), outs[i], workload, ckpt or "-"],
+             str(corpus), outs[i], workload, ckpt or "-", final or "-"],
             env=env, cwd=REPO, stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT, text=True) for i in range(nproc)]
         logs = []
@@ -262,3 +265,87 @@ def test_gather_strings_single_process():
     got = gather_strings([h1, h2], d)
     assert got == {h1: b"alpha"}
     assert gather_strings([], d) == {}
+
+
+def test_two_process_output_byte_identical_to_single(tmp_path):
+    """--output parity (the reference's primary artifact,
+    main.rs:170-182): a 2-process run writes per-partition shard files
+    whose concatenated, sorted rows are byte-identical to the
+    single-process final_result.txt — for wordcount AND invertedindex;
+    the distributed distinct file (written once, registers replicated)
+    must equal the single-process file outright."""
+    from map_oxidize_tpu.config import JobConfig
+    from map_oxidize_tpu.runtime import run_job
+
+    corpus = tmp_path / "po.txt"
+    _write_corpus(corpus, lines=1200)
+
+    def single(workload, out):
+        run_job(JobConfig(input_path=str(corpus), output_path=str(out),
+                          backend="cpu", num_shards=1, metrics=False,
+                          chunk_bytes=4096), workload)
+        return out.read_bytes()
+
+    def parts(workload, out):
+        _launch(tmp_path, corpus, 2, workload, final=str(out))
+        shard_files = sorted(tmp_path.glob(out.name + ".part*"))
+        assert [p.name for p in shard_files] == [
+            out.name + ".part0of2", out.name + ".part1of2"]
+        rows = []
+        for p in shard_files:
+            rows.extend(p.read_bytes().splitlines(keepends=True))
+        return b"".join(sorted(rows))
+
+    assert (parts("wordcount", tmp_path / "wc.txt")
+            == single("wordcount", tmp_path / "wc_single.txt"))
+    assert (parts("invertedindex", tmp_path / "ii.txt")
+            == single("invertedindex", tmp_path / "ii_single.txt"))
+
+    # wide-vocab corpus: most words live in only ONE process's chunks, so
+    # partition resolution MUST go through the cross-process miss gather
+    # (the 6-word corpus above resolves everything locally and would hide
+    # a broken gather — it did in round 5: 64-bit hashes shipped as int64
+    # were silently truncated to int32 by process_allgather)
+    wide = tmp_path / "wide.txt"
+    with open(wide, "wb") as f:
+        for i in range(3000):
+            f.write(b"unique%05d shared\n" % i)
+    corpus = wide
+    assert (parts("wordcount", tmp_path / "ww.txt")
+            == single("wordcount", tmp_path / "ww_single.txt"))
+
+    _launch(tmp_path, corpus, 2, "distinct", final=str(tmp_path / "d.txt"))
+    assert ((tmp_path / "d.txt").read_bytes()
+            == single("distinct", tmp_path / "d_single.txt"))
+
+
+def test_two_process_kmeans_matches_single_controller(tmp_path):
+    """Distributed k-means (the last multi-process carve-out, removed
+    round 5): 2 Gloo processes × 4 local devices run the SAME jitted psum
+    iteration as the single-controller 8-shard fit.  The two processes
+    must agree BITWISE (one replicated result); against the
+    single-controller run the Gloo allreduce sums shards in a different
+    order, so the comparison is ulp-tight (measured: 1 ulp, ~1.2e-7) but
+    not exact — float addition is not associative across collective
+    topologies.  The oracle comparison uses the usual float tolerance,
+    and process 0's --output file carries the replicated result."""
+    rng = np.random.default_rng(5)
+    pts = rng.normal(size=(1000, 8)).astype(np.float32)
+    path = tmp_path / "pts.npy"
+    np.save(path, pts)
+    out = tmp_path / "cent.npy"
+    results, _ = _launch(tmp_path, path, 2, "kmeans", final=str(out))
+    got = [np.array(r["centroids"], np.float32) for r in results]
+    np.testing.assert_array_equal(got[0], got[1])
+
+    from map_oxidize_tpu.parallel.kmeans import kmeans_fit_sharded
+    from map_oxidize_tpu.workloads.kmeans import kmeans_model
+
+    single = kmeans_fit_sharded(pts, pts[:4].copy(), iters=3,
+                                num_shards=8, backend="cpu")
+    np.testing.assert_allclose(got[0], single, rtol=2e-6, atol=2e-7)
+    want = pts[:4].copy()
+    for _ in range(3):
+        want = kmeans_model(pts, want)
+    np.testing.assert_allclose(got[0], want, rtol=1e-3, atol=1e-3)
+    np.testing.assert_array_equal(np.load(out), got[0])
