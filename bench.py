@@ -271,7 +271,7 @@ def main() -> int:
     # and is insensitive to ordering
     workloads = {}
     if BENCH_WORKLOADS:
-        workloads = _bench_workloads(run_job, JobConfig)
+        workloads = _bench_workloads(run_job, JobConfig, probes)
         _release_heap()
 
     # --- per-size sweep; the LAST size is the headline
@@ -416,6 +416,39 @@ def _session_probes() -> dict:
         del buf
     except Exception as e:  # cpu-only or tunnel-down hosts still bench
         probes["link_probe_error"] = str(e)
+    # matmul-peak probes: the ACHIEVABLE MXU rate on this part.  Round-5
+    # measurement: this chip sustains ~91 TFLOP/s bf16 and ~18 TFLOP/s
+    # f32(HIGHEST) on large square matmuls — about half the v5e nominal
+    # 197e12 — so an MFU quoted only against the nominal peak understates
+    # occupancy ~2x.  The kmeans entries report both.
+    try:
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        rng = np.random.default_rng(0)
+        # bf16 needs the larger shape to saturate (4096^3 reads ~8x low —
+        # launch-bound); f32-HIGHEST saturates at 4096^3 already
+        for name, m, f in (
+                ("bf16", 8192, lambda a, b: jnp.dot(
+                    a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
+                    preferred_element_type=jnp.float32)),
+                ("f32_highest", 4096, lambda a, b: jnp.dot(
+                    a, b, precision=lax.Precision.HIGHEST))):
+            a = jax.device_put(rng.normal(size=(m, m)).astype(np.float32))
+            b = jax.device_put(rng.normal(size=(m, m)).astype(np.float32))
+            reps = 10
+            g = jax.jit(lambda a, b, f=f: lax.fori_loop(
+                0, reps, lambda _, acc: acc + f(a, b)[0, 0], 0.0))
+            np.asarray(g(a, b))  # compile + warm
+            t0 = time.perf_counter()
+            np.asarray(g(a, b))
+            dt = (time.perf_counter() - t0) / reps
+            probes[f"matmul_peak_{name}_tflops"] = round(
+                2.0 * m ** 3 / dt / 1e12, 1)
+            del a, b
+    except Exception as e:
+        probes["matmul_probe_error"] = str(e)
     return probes
 
 
@@ -433,7 +466,7 @@ def _release_heap() -> None:
         pass  # non-glibc: harmless to skip
 
 
-def _bench_workloads(run_job, JobConfig) -> dict:
+def _bench_workloads(run_job, JobConfig, probes=None) -> dict:
     """Secondary workload benches (BASELINE configs 3-5): bigram and
     inverted index run at a real size (default 256MB) against a measured
     single-thread CPU baseline of the same semantics, with top-k/postings
@@ -818,6 +851,10 @@ def _bench_workloads(run_job, JobConfig) -> dict:
                 "mfu_pct": round(100 * flops / iter_s / peak, 2),
                 "precision": "f32(Precision.HIGHEST)",
             })
+            meas = (probes or {}).get("matmul_peak_f32_highest_tflops")
+            if meas:  # vs this part's MEASURED f32 matmul rate
+                entry["mfu_vs_measured_peak_pct"] = round(
+                    100 * flops / iter_s / (meas * 1e12), 2)
         out[f"kmeans_device_2m_d64_k256_{iters2}iter"] = entry
 
         # --- bf16 variant (round-4 verdict #6): --kmeans-precision bf16
@@ -857,6 +894,10 @@ def _bench_workloads(run_job, JobConfig) -> dict:
                 "flops_per_sec": round(flops / iter_sb, 1),
                 "mfu_pct": round(100 * flops / iter_sb / peak, 2),
             })
+            meas = (probes or {}).get("matmul_peak_bf16_tflops")
+            if meas:
+                entry_b["mfu_vs_measured_peak_pct"] = round(
+                    100 * flops / iter_sb / (meas * 1e12), 2)
         if not drift_ok:
             out["kmeans_bf16_error"] = (
                 f"bf16 drift {drift:.4f} exceeds rounding bound "

@@ -764,6 +764,13 @@ def _run_distributed_kmeans(config: JobConfig) -> DistributedResult:
     take = max(0, min(hi_row, n) - lo_row)
     if take:
         local[:take] = pts[lo_row:lo_row + take]
+    if config.kmeans_precision == "bf16":
+        # bf16 HBM storage, same as both single-controller fit paths: the
+        # per-iteration full read and the feed are the costs, and the
+        # matmul operand is cast down regardless
+        import ml_dtypes
+
+        local = local.astype(ml_dtypes.bfloat16)
     w_local = np.zeros(block, np.float32)
     w_local[:take] = 1.0
 
@@ -777,12 +784,9 @@ def _run_distributed_kmeans(config: JobConfig) -> DistributedResult:
                                 jax.device_put(centroids,
                                                NamedSharding(mesh, P())))))
     if config.output_path and proc == 0:
-        import os
+        from map_oxidize_tpu.workloads.kmeans import write_centroids
 
-        tmp = f"{config.output_path}.tmp.{os.getpid()}"
-        with open(tmp, "wb") as f:
-            np.save(f, out)
-        os.replace(tmp, config.output_path)
+        write_centroids(config.output_path, out)
     _log.info("distributed kmeans: %d processes, %d points, k=%d, %d "
               "iterations", n_proc, n, k, config.kmeans_iters)
     return DistributedResult(counts=None, top=[], n_keys=0,

@@ -89,6 +89,13 @@ def kmeans_fit_sharded(points, centroids, iters: int = 1, mesh=None,
     if n_pad != n:
         points = np.concatenate(
             [points, np.zeros((n_pad - n, d), np.float32)])
+    if precision == "bf16":
+        # bf16 HBM storage: same rationale as kmeans_fit_device — the
+        # per-iteration full read is the bottleneck, and the matmul
+        # operand is cast down regardless
+        import ml_dtypes
+
+        points = points.astype(ml_dtypes.bfloat16)
     weights = np.zeros(n_pad, np.float32)
     weights[:n] = 1.0
 

@@ -770,14 +770,9 @@ def run_kmeans_job(config: JobConfig, centroids: np.ndarray | None = None
                     _save(it + 1, centroids)
     with metrics.phase("write"):
         if config.output_path:
-            # write to the EXACT configured path (np.save(str) would append
-            # '.npy'), atomically like every other writer
-            import os
+            from map_oxidize_tpu.workloads.kmeans import write_centroids
 
-            tmp = f"{config.output_path}.tmp.{os.getpid()}"
-            with open(tmp, "wb") as f:
-                np.save(f, centroids)
-            os.replace(tmp, config.output_path)
+            write_centroids(config.output_path, centroids)
     ran_iters = max(config.kmeans_iters - start_iter, 0)
     if store:
         # a zero-work run (the snapshot already covered every requested

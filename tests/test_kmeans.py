@@ -394,3 +394,27 @@ def test_bf16_precision_convergence_parity(tmp_path, rng):
     with pytest.raises(ValueError, match="kmeans_precision"):
         JobConfig(input_path=str(inp), output_path="",
                   kmeans_precision="f64").validate()
+
+
+def test_pallas_fused_kernel_parity(rng):
+    """The fused Pallas assignment+partial-sum kernel (interpret mode on
+    CPU) must reproduce assign_and_sum exactly in structure: equal
+    counts, close sums, both precisions, with and without weights, and
+    with tail padding exercised (n not a TILE_N multiple)."""
+    import jax.numpy as jnp
+
+    from map_oxidize_tpu.ops.kmeans_kernel import TILE_N, fused_assign_sum
+    from map_oxidize_tpu.workloads.kmeans import assign_and_sum
+
+    n, d, k = TILE_N + 777, 16, 32  # forces the padding mask path
+    p = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+    c = jnp.asarray(rng.normal(size=(k, d)).astype(np.float32))
+    w = jnp.asarray((rng.random(n) > 0.3).astype(np.float32))
+    for prec in ("highest", "bf16"):
+        for weights in (None, w):
+            s1, c1 = fused_assign_sum(p, c, k, prec, w=weights,
+                                      interpret=True)
+            s2, c2 = assign_and_sum(p, c, k, prec, w=weights)
+            np.testing.assert_array_equal(np.asarray(c1), np.asarray(c2))
+            np.testing.assert_allclose(np.asarray(s1), np.asarray(s2),
+                                       rtol=1e-5, atol=1e-4)
