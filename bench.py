@@ -426,6 +426,11 @@ def _session_probes() -> dict:
         import jax.numpy as jnp
         from jax import lax
 
+        if jax.devices()[0].platform == "cpu":
+            # ~25 TFLOP of probe matmuls would grind for minutes on the
+            # 1-core host and record meaningless "peaks"
+            probes["matmul_probe_skipped"] = "cpu-only host"
+            return probes
         rng = np.random.default_rng(0)
         # bf16 needs the larger shape to saturate (4096^3 reads ~8x low —
         # launch-bound); f32-HIGHEST saturates at 4096^3 already
@@ -438,8 +443,19 @@ def _session_probes() -> dict:
             a = jax.device_put(rng.normal(size=(m, m)).astype(np.float32))
             b = jax.device_put(rng.normal(size=(m, m)).astype(np.float32))
             reps = 10
+            # hoist-proof AND pipelineable: each rep's operand differs by
+            # a one-element scatter (LICM cannot treat the dot as
+            # loop-invariant), but reps carry no matmul->matmul data
+            # dependency, so they overlap like real back-to-back work.  A
+            # carry-chained form measured ~2x LOW (dependent HIGHEST
+            # passes cannot pipeline — it read below what the kmeans loop
+            # itself achieves); a fully invariant body risks reading
+            # reps x HIGH if hoisted.
             g = jax.jit(lambda a, b, f=f: lax.fori_loop(
-                0, reps, lambda _, acc: acc + f(a, b)[0, 0], 0.0))
+                0, reps,
+                lambda i, acc: acc + f(
+                    a.at[0, 0].set(i.astype(jnp.float32)), b)[0, 0],
+                jnp.float32(0.0)))
             np.asarray(g(a, b))  # compile + warm
             t0 = time.perf_counter()
             np.asarray(g(a, b))

@@ -69,8 +69,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "the measured winner on a remote-attached chip)")
     p.add_argument("--collect-max-rows", type=int, default=0,
                    help="resident-row cap for the collect engines before "
-                        "the disk-bucket spill (hash-only counts) or a "
-                        "loud abort (pair/value jobs); 0 = engine defaults")
+                        "the disk-bucket spill (counts, values, and "
+                        "(key,doc) pairs all spill; the sharded device "
+                        "engine demotes to the host engine first); "
+                        "0 = engine defaults")
     p.add_argument("--rescan-full", action="store_true",
                    help="hash-only mode: rescan the whole corpus when "
                         "resolving winner strings (extends the collision "

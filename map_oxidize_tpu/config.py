@@ -100,10 +100,12 @@ class JobConfig:
     #: rate; assignment boundaries can shift within bf16 rounding).  The
     #: streamed (host-assign) path is NumPy f32 and ignores this.
     kmeans_precision: str = "highest"
-    #: collect engines: resident-row cap before the host collect-reduce
-    #: switches to its disk-bucket spill (hash-only count jobs) or the
-    #: engines abort (explicit-value / pair jobs).  0 = engine defaults
-    #: (host collect 2^28, pair collect 2^27).
+    #: collect engines: resident-row cap before the disk-bucket spill —
+    #: hash-only counts, explicit (key, value) rows, and (key, doc) pairs
+    #: all spill; the sharded device engine first demotes its HBM buffers
+    #: to the host engine.  0 = engine defaults (host collect 2^28, pair
+    #: collect 2^27).  Multi-process pair collect still aborts at the cap
+    #: (cross-process demotion is not implemented).
     collect_max_rows: int = 0
 
     def validate(self) -> "JobConfig":

@@ -171,6 +171,19 @@ class ShardedCollectEngine:
         self._buf = self._make_grow(new_R - self.R)(*self._buf)
         self.R = new_R
 
+    #: host engine this run demoted to past max_rows (None = still on
+    #: device).  Its disk-bucket spill is what makes the demotion useful:
+    #: beyond-HBM -> host RAM -> disk, each level handing to the next.
+    _host = None
+
+    @property
+    def spilled(self) -> bool:
+        return self._host is not None and self._host.spilled
+
+    @property
+    def spilled_rows(self) -> int:
+        return 0 if self._host is None else self._host.spilled_rows
+
     def feed(self, out: MapOutput) -> None:
         n = len(out)
         self.rows_fed += n
@@ -180,14 +193,72 @@ class ShardedCollectEngine:
         vals = out.values
         if vals.ndim != 2 or vals.shape[1] != 2 or vals.dtype != np.uint32:
             raise ValueError("collect engines expect (n, 2) uint32 doc planes")
+        if self._host is not None:
+            self._host.rows_fed = self.rows_fed - n  # its feed re-adds n
+            self._host.feed(out)
+            return
         if self.rows_fed > self.max_rows:
-            raise RuntimeError(
-                f"ShardedCollectEngine exceeded max_rows={self.max_rows}; "
-                "shard wider or raise the limit")
+            self._demote_to_host()
+            self._host.feed(out)
+            return
         self._stage.append((out.hi, out.lo, vals))
         self._staged += n
         if self._staged >= self.feed_batch:
             self.flush()
+
+    def _demote_to_host(self) -> None:
+        """Crossing max_rows means the device-resident formulation no
+        longer fits in HBM: drain the per-shard buffers into the host
+        collect engine, whose disk-bucket spill takes over.  Per-term doc
+        order survives the drain — a term's rows route to exactly one
+        shard, appended in feed order, and the compaction sort is a
+        STABLE key sort — so the drained compact blocks satisfy the host
+        engine's ascending-doc invariant."""
+        from map_oxidize_tpu.runtime.collect import CollectEngine
+
+        self.flush()
+        self._check_exchange_overflows()
+        _log.info(
+            "sharded collect crossed max_rows=%d; demoting the %d-shard "
+            "device buffers to the host engine (disk-bucket spill)",
+            self.max_rows, self.S)
+        host = CollectEngine(self.config, max_rows=self.max_rows)
+        host.sort_mode = "host"  # demotion target regardless of collect_sort
+        host.device = None
+        if self._buf is not None:
+            s_hi, s_lo, s_dhi, s_dlo = [self._fetch(x) for x in self._buf]
+            sent = np.uint32(SENTINEL)
+            for s in range(self.S):
+                live = ~((s_hi[s] == sent) & (s_lo[s] == sent))
+                if not live.any():
+                    continue
+                keys = ((s_hi[s][live].astype(np.uint64) << np.uint64(32))
+                        | s_lo[s][live])
+                docs = ((s_dhi[s][live].astype(np.uint64) << np.uint64(32))
+                        | s_dlo[s][live]).view(np.int64)
+                host.feed(MapOutput(hi=None, lo=None, values=None,
+                                    records_in=0, keys64=keys, docs64=docs))
+            self._buf = None
+            self._cursor = None
+        host.rows_fed = self.rows_fed
+        self._host = host
+
+    def _check_exchange_overflows(self) -> None:
+        for ovf in self._overflows:
+            dropped = int(np.asarray(ovf))
+            if dropped:
+                raise RuntimeError(
+                    f"{dropped} rows dropped in the collect exchange: a "
+                    "bucket overflowed bucket_cap; use the default safe "
+                    "cap or raise it")
+        self._overflows = []
+
+    def finalize_spilled_csr(self):
+        """Delegates to the demoted host engine (see
+        :meth:`CollectEngine.finalize_spilled_csr`)."""
+        if self._host is None:
+            raise RuntimeError("engine did not demote/spill; use finalize")
+        return self._host.finalize_spilled_csr()
 
     def flush(self) -> None:
         if not self._staged:
@@ -225,14 +296,10 @@ class ShardedCollectEngine:
         """Route + sort everything fed; returns host ``(keys_u64, docs_i64)``
         with per-shard sorted runs concatenated (term segments are disjoint
         across shards, so segment detection downstream is unaffected)."""
+        if self._host is not None:
+            return self._host.finalize()
         self.flush()
-        for ovf in self._overflows:
-            dropped = int(np.asarray(ovf))
-            if dropped:
-                raise RuntimeError(
-                    f"{dropped} rows dropped in the collect exchange: a "
-                    "bucket overflowed bucket_cap; use the default safe cap "
-                    "or raise it")
+        self._check_exchange_overflows()
         if self._buf is None:
             return np.empty(0, np.uint64), np.empty(0, np.int64)
         s_hi, s_lo, s_dhi, s_dlo = [self._fetch(x)

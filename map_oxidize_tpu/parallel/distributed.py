@@ -242,7 +242,9 @@ class DistributedCollectEngine(ShardedCollectEngineBase):
         if self.rows_fed > self.max_rows:
             raise RuntimeError(
                 f"DistributedCollectEngine exceeded max_rows="
-                f"{self.max_rows}; shard wider or raise the limit")
+                f"{self.max_rows}; shard wider or raise the limit "
+                "(the single-controller engines demote/spill to disk, "
+                "but cross-process demotion is not implemented)")
 
         def pad(a, fill=SENTINEL, dtype=np.uint32):
             p = np.full(self.local_rows, fill, dtype)

