@@ -616,7 +616,7 @@ def _adopt_checkpoint_kmeans_mode(config: JobConfig,
     except (OSError, ValueError):
         return None
     stored = existing.get("kmeans_mode")
-    if stored not in ("device", "stream"):
+    if stored not in ("device", "stream", "stream_device"):
         return None
     probe = {k: v for k, v in existing.items()
              if k not in ("kmeans_mode", "kmeans_shards", "version")}
@@ -666,12 +666,17 @@ def run_kmeans_job(config: JobConfig, centroids: np.ndarray | None = None
     centroids = np.asarray(centroids, np.float32)
     rows = max(1, config.chunk_bytes // (4 * d))
     if config.mapper == "device":
-        device_mode = True
+        mode = "device"
     elif config.mapper == "auto":
         # whole device working set: points + the (n, k) distance/one-hot
-        # intermediates (see _kmeans_device_fit_bytes)
-        device_mode = (4 * int(n) * (int(d) + 2 * config.kmeans_k)
-                       <= _kmeans_device_fit_bytes(config.backend))
+        # intermediates (see _kmeans_device_fit_bytes).  Beyond the fit,
+        # 'auto' streams chunks THROUGH the device
+        # (kmeans_fit_streamed_device): measured above both the host-
+        # assign engine (~2x) and, in bf16, the NumPy baseline at the
+        # multi-GB scale this regime is about (RESULTS.md round 5)
+        fits = (4 * int(n) * (int(d) + 2 * config.kmeans_k)
+                <= _kmeans_device_fit_bytes(config.backend))
+        mode = "device" if fits else "stream_device"
         if config.checkpoint_dir:
             # an existing snapshot's mode wins over the heuristic: resume
             # must continue the trajectory it was cut from
@@ -689,9 +694,10 @@ def run_kmeans_job(config: JobConfig, centroids: np.ndarray | None = None
                         centroids.tobytes()).hexdigest()[:16],
                 }))
             if stored is not None:
-                device_mode = stored == "device"
+                mode = stored  # "device" | "stream_device" | "stream"
     else:
-        device_mode = False
+        mode = "stream"
+    device_mode = mode == "device"
     n_shards = effective_num_shards(config) if device_mode else 1
 
     # --- checkpoint/resume: the iteration boundary is k-means's natural
@@ -717,7 +723,7 @@ def run_kmeans_job(config: JobConfig, centroids: np.ndarray | None = None
             config.checkpoint_dir,
             CheckpointStore.job_meta(config, "kmeans", extra={
                 "kmeans_k": config.kmeans_k,
-                "kmeans_mode": "device" if device_mode else "stream",
+                "kmeans_mode": mode,
                 "kmeans_shards": n_shards,
                 # backend changes float accumulation order (CPU XLA vs MXU)
                 # exactly like mode/shards do, so it is identity too
@@ -750,6 +756,34 @@ def run_kmeans_job(config: JobConfig, centroids: np.ndarray | None = None
                     "checkpoint has %d iterations, more than the %d "
                     "requested; returning the snapshotted state",
                     start_iter, config.kmeans_iters)
+        elif mode == "stream_device":
+            from map_oxidize_tpu.workloads.kmeans import (
+                kmeans_fit_streamed_device,
+            )
+
+            from map_oxidize_tpu.runtime.engine import pick_device
+
+            # dispatch amortization wants BIG chunks (~200ms per launch
+            # through the measured tunnel, RESULTS.md round 5): floor the
+            # per-chunk bytes at 256MB regardless of config.chunk_bytes.
+            # The divisor budgets the per-chunk DEVICE working set — the
+            # points block plus the (chunk, k) distance and one-hot
+            # intermediates — the same 4*(d + 2k) accounting as the fit
+            # heuristic, else a large-k job would OOM the chip with the
+            # very path meant to avoid that.
+            chunk_rows = max(1, max(config.chunk_bytes, 256 << 20)
+                             // (4 * (int(d) + 2 * config.kmeans_k)))
+            timings: dict = {}
+            centroids = kmeans_fit_streamed_device(
+                config.input_path, centroids, iters=remaining,
+                chunk_rows=chunk_rows,
+                device=pick_device(config.backend),
+                precision=config.kmeans_precision,
+                timings=timings,
+                on_iter=((lambda i, c: _save(start_iter + i, c))
+                         if store else None))
+            for tk, tv in timings.items():
+                metrics.set(f"time/{tk}", round(tv, 4))
         elif device_mode:
             on_iter = ((lambda i, c: _save(start_iter + i, c))
                        if store else None)

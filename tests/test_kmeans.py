@@ -418,3 +418,67 @@ def test_pallas_fused_kernel_parity(rng):
             np.testing.assert_array_equal(np.asarray(c1), np.asarray(c2))
             np.testing.assert_allclose(np.asarray(s1), np.asarray(s2),
                                        rtol=1e-5, atol=1e-4)
+
+
+def test_streamed_device_fit_matches_oracle(tmp_path, rng):
+    """Round-5 (verdict r4 #5): the device-streamed fit (chunks through
+    the chip, one dispatch per chunk, update folded into the last) must
+    match the repeated NumPy oracle across chunking shapes — multi-chunk
+    with a padded tail, and the single-chunk first==last fusion."""
+    from map_oxidize_tpu.workloads.kmeans import kmeans_fit_streamed_device
+
+    pts, centers = _blobs(rng, n=5000, d=8, k=5)
+    pts[:5] = centers
+    path = tmp_path / "p.npy"
+    np.save(path, pts)
+    init = pts[:5].copy()
+    want = init
+    for _ in range(3):
+        want = kmeans_model(pts, want)
+    for chunk_rows in (1024, 8192):
+        got = kmeans_fit_streamed_device(str(path), init, iters=3,
+                                         chunk_rows=chunk_rows)
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+    gb = kmeans_fit_streamed_device(str(path), init, iters=3,
+                                    chunk_rows=1024, precision="bf16")
+    scale = float(np.abs(pts).max())
+    assert float(np.abs(gb - want).max()) <= 4 * 2.0**-8 * scale
+
+
+def test_auto_routes_beyond_fit_to_streamed_device(tmp_path, rng,
+                                                   monkeypatch):
+    """mapper='auto' with points past the device-fit budget must take the
+    device-streamed route (r4 the streamed fallback was host-assign),
+    produce oracle-correct centroids, record feed_s, and resume from its
+    own checkpoints under the 'stream_device' mode identity."""
+    import map_oxidize_tpu.runtime.driver as drv
+
+    pts, centers = _blobs(rng, n=4000, d=6, k=3)
+    pts[:3] = centers
+    inp = tmp_path / "p.npy"
+    np.save(inp, pts)
+    monkeypatch.setattr(drv, "_kmeans_device_fit_bytes", lambda b: 1)
+
+    cfg = JobConfig(input_path=str(inp), output_path="", backend="cpu",
+                    kmeans_k=3, kmeans_iters=2, mapper="auto",
+                    metrics=False)
+    res = run_kmeans_job(cfg)
+    want = pts[:3].copy()
+    for _ in range(2):
+        want = kmeans_model(pts, want)
+    np.testing.assert_allclose(res.centroids, want, rtol=1e-3, atol=1e-3)
+    assert "time/feed_s" in res.metrics
+
+    # checkpointed: 1-iter run, then resume to 3 — identical to a fresh
+    # 3-iter run (the snapshot's stream_device mode is adopted)
+    import dataclasses
+
+    ck = str(tmp_path / "ck")
+    run_kmeans_job(dataclasses.replace(cfg, kmeans_iters=1,
+                                       checkpoint_dir=ck,
+                                       keep_intermediates=True))
+    resumed = run_kmeans_job(dataclasses.replace(cfg, kmeans_iters=3,
+                                                 checkpoint_dir=ck))
+    fresh = run_kmeans_job(dataclasses.replace(cfg, kmeans_iters=3))
+    np.testing.assert_array_equal(resumed.centroids, fresh.centroids)
+    assert resumed.metrics.get("resumed_iters") == 1
