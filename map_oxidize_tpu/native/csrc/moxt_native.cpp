@@ -1191,6 +1191,11 @@ MoxtFile* moxt_file_open(const char* path) {
   f->size = sb.st_size;
   f->data = nullptr;
   if (f->size > 0) {
+    // plain mmap, NO madvise: MADV_SEQUENTIAL(+HUGEPAGE) measured 3-4%
+    // SLOWER on the warm 10GB scan in every same-session A/B pair
+    // (round 5, benchmarks/RESULTS.md) — the drop-behind eviction costs
+    // more than the readahead buys when the corpus is page-cache
+    // resident, and file-backed THP did not engage on this kernel.
     void* p = mmap(nullptr, f->size, PROT_READ, MAP_PRIVATE, fd, 0);
     if (p == MAP_FAILED) {
       close(fd);
