@@ -26,6 +26,9 @@ pid = int(sys.argv[1]); nproc = int(sys.argv[2]); port = sys.argv[3]
 corpus = sys.argv[4]; out_path = sys.argv[5]; workload = sys.argv[6]
 ckpt = sys.argv[7] if len(sys.argv) > 7 and sys.argv[7] != "-" else None
 final = sys.argv[8] if len(sys.argv) > 8 and sys.argv[8] != "-" else ""
+precision = "highest"
+if workload == "kmeans_bf16":  # kmeans with the bf16 storage/matmul mode
+    workload, precision = "kmeans", "bf16"
 from map_oxidize_tpu.config import JobConfig
 from map_oxidize_tpu.parallel.distributed import (
     init_distributed, run_distributed_job)
@@ -49,7 +52,7 @@ cfg = JobConfig(input_path=corpus, output_path=final, chunk_bytes=4096,
                 batch_size=1 << 12, key_capacity=1 << 12, top_k=5,
                 metrics=False, checkpoint_dir=ckpt,
                 keep_intermediates=bool(ckpt),
-                kmeans_k=4, kmeans_iters=3)
+                kmeans_k=4, kmeans_iters=3, kmeans_precision=precision)
 r = run_distributed_job(cfg, workload)
 payload = {
     "n_keys": r.n_keys, "n_pairs": r.n_pairs, "records": r.records,
@@ -349,3 +352,29 @@ def test_two_process_kmeans_matches_single_controller(tmp_path):
         want = kmeans_model(pts, want)
     np.testing.assert_allclose(got[0], want, rtol=1e-3, atol=1e-3)
     np.testing.assert_array_equal(np.load(out), got[0])
+
+
+def test_two_process_kmeans_bf16_matches_sharded(tmp_path):
+    """The bf16 storage/matmul mode through the multi-process path: local
+    row blocks cast to ml_dtypes.bfloat16 before assembly must produce
+    the same (replicated, bitwise-identical across processes) centroids
+    as the single-controller sharded bf16 fit within collective-order
+    tolerance — the same numerics family, so drift stays at the ulp
+    level, NOT the bf16 rounding bound."""
+    rng = np.random.default_rng(6)
+    centers = rng.normal(0, 10, size=(4, 8)).astype(np.float32)
+    pts = (centers[rng.integers(0, 4, 900)]
+           + rng.normal(0, 0.5, size=(900, 8))).astype(np.float32)
+    pts[:4] = centers
+    path = tmp_path / "pb.npy"
+    np.save(path, pts)
+    results, _ = _launch(tmp_path, path, 2, "kmeans_bf16")
+    got = [np.array(r["centroids"], np.float32) for r in results]
+    np.testing.assert_array_equal(got[0], got[1])
+
+    from map_oxidize_tpu.parallel.kmeans import kmeans_fit_sharded
+
+    single = kmeans_fit_sharded(pts, pts[:4].copy(), iters=3,
+                                num_shards=8, backend="cpu",
+                                precision="bf16")
+    np.testing.assert_allclose(got[0], single, rtol=2e-5, atol=2e-5)
