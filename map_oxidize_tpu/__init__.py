@@ -25,7 +25,7 @@ Layer map (mirrors SURVEY.md §1, redrawn TPU-first):
   tier is the whole Rust binary; ours is the one loop that deserves it)
 """
 
-__version__ = "0.1.0"
+__version__ = "0.5.0"  # keep in sync with pyproject.toml
 
 from map_oxidize_tpu.api import (
     Mapper,

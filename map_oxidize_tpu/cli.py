@@ -26,6 +26,12 @@ def build_parser() -> argparse.ArgumentParser:
         prog="map_oxidize_tpu",
         description="TPU-native MapReduce (capabilities of map-oxidize, rebuilt for JAX/XLA)",
     )
+    # the RUNNING package's version (a dist-info lookup would report a
+    # stale installed copy when a newer checkout shadows it on sys.path)
+    from map_oxidize_tpu import __version__
+
+    p.add_argument("--version", action="version",
+                   version=f"%(prog)s {__version__}")
     p.add_argument("workload",
                    choices=["wordcount", "bigram", "invertedindex", "kmeans",
                             "distinct"],
