@@ -328,6 +328,7 @@ def main() -> int:
                 "phases": {k: round(v, 4)
                            for k, v in result.metrics.items()
                            if k.startswith("time/")},
+                "metrics_snapshot": _metrics_snapshot(result),
             })
             continue
         result, secs, times = _run_size(run_job, JobConfig, corpus, warm=True)
@@ -343,6 +344,7 @@ def main() -> int:
             "distinct_keys": int(result.metrics["distinct_keys"]),
             "phases": {k: round(v, 4) for k, v in result.metrics.items()
                        if k.startswith("time/")},
+            "metrics_snapshot": _metrics_snapshot(result),
         })
         headline = (rate, words, rate / base_rate)
 
@@ -468,6 +470,20 @@ def _session_probes() -> dict:
     return probes
 
 
+def _metrics_snapshot(result) -> dict:
+    """Per-workload observability snapshot for BENCH_DETAIL.json: phase
+    wall-clocks, spill/demotion/shuffle volume counters, peak RSS, and
+    feed/flush latency quantiles from the job's obs registry — so a
+    future BENCH_r*.json delta can be decomposed by phase instead of
+    re-run archaeology."""
+    m = getattr(result, "metrics", None) or {}
+    snap = {k: v for k, v in m.items()
+            if k.startswith(("time/", "spill/", "demote/", "checkpoint/",
+                             "shuffle/", "engine/", "mem/",
+                             "feed_block_ms/"))}
+    return snap
+
+
 def _release_heap() -> None:
     """Return freed arena pages to the kernel between bench phases so one
     phase's peak heap doesn't tax the next phase's allocations (measured:
@@ -560,6 +576,7 @@ def _bench_workloads(run_job, JobConfig, probes=None) -> dict:
             "vs_baseline": round(rate / bigram_base_rate, 3),
             "cpu_baseline_words_per_sec": round(bigram_base_rate, 1),
             "distinct_keys": int(r.metrics["distinct_keys"]),
+            "metrics_snapshot": _metrics_snapshot(r),
         }
 
     # --- inverted index (config #4: variable-length values)
@@ -593,6 +610,7 @@ def _bench_workloads(run_job, JobConfig, probes=None) -> dict:
             "cpu_baseline_tokens_per_sec": round(ii_base_rate, 1),
             "pairs": int(r.metrics["pairs"]),
             "distinct_terms": int(r.metrics["distinct_terms"]),
+            "metrics_snapshot": _metrics_snapshot(r),
         }
 
     # --- distinct (beyond-reference): HyperLogLog approximate cardinality.
@@ -624,6 +642,7 @@ def _bench_workloads(run_job, JobConfig, probes=None) -> dict:
             "estimate": round(r.estimate, 1),
             "slice_error_pct": round(
                 100 * abs(sr.estimate - exact_slice) / exact_slice, 2),
+            "metrics_snapshot": _metrics_snapshot(r),
         }
 
     # --- wordcount on REAL text (BASELINE's shakes.txt/enwik9 intent):

@@ -112,6 +112,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--trace-dir", default=None,
                    help="capture a jax.profiler trace of the run into this "
                         "directory (TensorBoard-compatible)")
+    p.add_argument("--trace-out", default=None,
+                   help="capture framework spans (phases, per-block feeds, "
+                        "spills, demotions) and write Chrome trace-event "
+                        "JSON here — load in chrome://tracing or Perfetto")
+    p.add_argument("--metrics-out", default=None,
+                   help="write the structured metrics document (phase "
+                        "timings, counters, gauges, histograms) here as "
+                        "JSON")
+    p.add_argument("--progress", action="store_true",
+                   help="log periodic progress lines (rows/sec, percent "
+                        "done, ETA, phase) for long streamed jobs")
+    p.add_argument("--progress-interval", type=float, default=10.0,
+                   help="minimum seconds between --progress lines")
     p.add_argument("--keep-intermediates", action="store_true")
     p.add_argument("-v", "--verbose", action="store_true")
     p.add_argument("-q", "--quiet", action="store_true")
@@ -142,6 +155,10 @@ def config_from_args(args: argparse.Namespace) -> JobConfig:
         checkpoint_dir=args.checkpoint_dir,
         keep_intermediates=args.keep_intermediates,
         trace_dir=args.trace_dir,
+        trace_out=args.trace_out,
+        metrics_out=args.metrics_out,
+        progress=args.progress,
+        progress_interval_s=args.progress_interval,
         rescan_full=args.rescan_full,
         collect_max_rows=args.collect_max_rows,
         hll_precision=args.hll_precision,

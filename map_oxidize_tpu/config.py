@@ -74,6 +74,18 @@ class JobConfig:
     use_native: bool = True
     #: emit per-phase timing/throughput metrics
     metrics: bool = True
+    #: write the structured metrics document (phases, counters, gauges,
+    #: histograms — obs.MetricsRegistry.to_dict) here as JSON; None skips
+    metrics_out: str | None = None
+    #: capture framework spans and write Chrome trace-event JSON here
+    #: (chrome://tracing / Perfetto); "-" collects the trace onto
+    #: ``result.trace`` without writing a file; None disables tracing
+    trace_out: str | None = None
+    #: emit periodic progress lines (rows/sec, percent, ETA, phase) for
+    #: long streamed jobs
+    progress: bool = False
+    #: minimum seconds between progress lines
+    progress_interval_s: float = 10.0
     #: multi-host: coordination-service address ("host:port"); empty = the
     #: single-process path.  With it set, dist_num_processes and
     #: dist_process_id select this process's slot; jax.distributed is
@@ -139,6 +151,8 @@ class JobConfig:
                              f"got {self.kmeans_precision!r}")
         if self.collect_max_rows < 0:
             raise ValueError("collect_max_rows must be >= 0 (0 = default)")
+        if self.progress_interval_s <= 0:
+            raise ValueError("progress_interval_s must be positive")
         from map_oxidize_tpu.workloads.distinct import HLL_P_MIN, HLL_P_MAX
 
         if not HLL_P_MIN <= self.hll_precision <= HLL_P_MAX:

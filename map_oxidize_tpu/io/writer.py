@@ -43,6 +43,32 @@ def write_postings(path: str, postings: dict[bytes, list[int]]) -> int:
     return n
 
 
+def write_postings_stream(path: str,
+                          items: "Iterable[tuple[bytes, 'object']]"
+                          ) -> tuple[int, int]:
+    """Streaming variant of :func:`write_postings` for CSR-backed sources:
+    ``items`` yields ``(term_bytes, doc_id_array)`` pairs **already in the
+    intended term order** with doc ids ascending, and each line streams to
+    disk as it is produced — residency is one term's postings, never the
+    whole partition (the dict-of-int-lists form boxes every doc id of
+    every term at once, which at multi-process scale is exactly the
+    blowup the CSR design exists to avoid).  Same line format and atomic
+    replace as :func:`write_postings`.  Returns ``(terms, bytes)``
+    written."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    n = 0
+    total = 0
+    with open(tmp, "wb") as f:
+        for term, docs in items:
+            line = (term + b"\t"
+                    + b" ".join(b"%d" % d for d in docs.tolist()) + b"\n")
+            f.write(line)
+            n += 1
+            total += len(line)
+    os.replace(tmp, path)
+    return n, total
+
+
 def format_top_words(top: list[tuple[bytes, int]], k: int) -> str:
     """The reference's stdout report (main.rs:188-191): ``Top {k} words:``
     then ``{word}: {count}`` lines."""

@@ -1,0 +1,143 @@
+"""Unified observability: spans, metrics, and a progress heartbeat.
+
+The reference's entire observability surface is a ``println!`` of the
+top-10 (``/root/reference/src/main.rs:188-191``); the seed's was a flat
+61-line phase-timer dict.  This package is the instrumentation discipline
+the ROADMAP's scale targets require (the same discipline Exoshuffle,
+arXiv:2203.05072, credits for making shuffle regressions debuggable):
+
+* :class:`~map_oxidize_tpu.obs.trace.Tracer` — nested, thread-safe spans
+  with attributes (rows, bytes, device, spill generation), exportable as
+  Chrome trace-event JSON (``chrome://tracing`` / Perfetto) or JSONL.
+* :class:`~map_oxidize_tpu.obs.metrics.MetricsRegistry` — counters,
+  gauges, and lightweight histograms (p50/p95/max) behind the seed
+  ``Metrics`` surface (``phase``/``count``/``set``/``summary``), so every
+  existing consumer keeps working.
+* :class:`~map_oxidize_tpu.obs.heartbeat.Heartbeat` — opt-in periodic
+  progress lines (rows/sec, percent done, ETA, phase) for long streamed
+  jobs.
+
+:class:`Obs` bundles the three per job and owns the config wiring
+(``--metrics-out`` / ``--trace-out`` / ``--progress``).  One ``Obs`` is
+created per job run and *injected* into engines and checkpoint stores —
+replacing the ad-hoc per-driver ``Metrics()`` instantiations — so every
+layer (driver, engine, collect, shuffle, spill, checkpoint) records into
+one coherent event model.
+
+See ``docs/OBSERVABILITY.md`` for the event model and flag reference.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+from dataclasses import dataclass
+
+from map_oxidize_tpu.obs.heartbeat import Heartbeat
+from map_oxidize_tpu.obs.metrics import (
+    Histogram,
+    MetricsRegistry,
+    sample_device_memory,
+    sample_host_memory,
+)
+from map_oxidize_tpu.obs.trace import NULL_SPAN, Span, Tracer
+
+__all__ = [
+    "Heartbeat",
+    "Histogram",
+    "MetricsRegistry",
+    "NULL_SPAN",
+    "Obs",
+    "Span",
+    "Tracer",
+    "sample_device_memory",
+    "sample_host_memory",
+]
+
+
+@dataclass
+class Obs:
+    """Per-job observability bundle: one registry, one tracer, and an
+    optional heartbeat, threaded through driver -> engine -> spill layers.
+
+    Always constructed (metrics were always-on in the seed too); the
+    tracer is enabled only when the job asked for a trace, and its
+    disabled spans are a shared no-op object, so the hot-path cost of an
+    un-traced run is one attribute check per span site.
+    """
+
+    registry: MetricsRegistry
+    tracer: Tracer
+    heartbeat: Heartbeat | None = None
+
+    @classmethod
+    def from_config(cls, config) -> "Obs":
+        """Build the bundle a job's config asks for.  ``trace_out='-'``
+        collects the trace for ``result.trace`` without writing a file."""
+        tracer = Tracer(enabled=bool(config.trace_out))
+        hb = None
+        if getattr(config, "progress", False):
+            total = None
+            try:
+                total = os.path.getsize(config.input_path)
+            except OSError:
+                pass
+            hb = Heartbeat(total_bytes=total,
+                           interval_s=config.progress_interval_s)
+        return cls(registry=MetricsRegistry(), tracer=tracer, heartbeat=hb)
+
+    @contextlib.contextmanager
+    def phase(self, name: str, **attrs):
+        """One job phase: wall-clocked in the registry, a top-level span in
+        the trace, the heartbeat's current phase label, and a host-RSS
+        watermark sample on exit (phase boundaries are where residency
+        peaks: finalize fetches, sort buffers, write staging)."""
+        if self.heartbeat is not None:
+            self.heartbeat.set_phase(name)
+        with self.tracer.span(f"phase/{name}", **attrs):
+            with self.registry.phase(name):
+                try:
+                    yield
+                finally:
+                    sample_host_memory(self.registry)
+
+    def feed_span(self, **attrs) -> "Span":
+        """Span for one mapped block's engine feed (the per-block latency
+        site every driver instruments)."""
+        return self.tracer.span("engine/feed_block", **attrs)
+
+    def finish(self, config) -> tuple[dict, list | None]:
+        """End-of-job hook: final memory watermarks, flag-driven file
+        exports, and the ``(summary, trace_events)`` pair the result
+        carries.  ``trace_events`` is None when tracing was off."""
+        sample_host_memory(self.registry)
+        sample_device_memory(self.registry)
+        if self.heartbeat is not None:
+            self.heartbeat.final_beat()
+        if config.metrics_out:
+            write_json_atomic(config.metrics_out, self.registry.to_dict())
+        trace = self.tracer.chrome_trace() if self.tracer.enabled else None
+        if trace is not None and config.trace_out != "-":
+            # dump the list just built — rebuilding it via write_chrome
+            # would pay the tid-compaction/scalarize pass twice
+            write_json_atomic(config.trace_out, trace, indent=None)
+        return self.registry.summary(), trace
+
+
+def write_json_atomic(path: str, payload, indent: int | None = 1) -> None:
+    """Write ``payload`` as JSON via temp-file + rename (same atomicity
+    contract as every other artifact writer in the repo).  ``indent=None``
+    for bulk documents (trace event lists) where compactness wins."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=indent, default=_json_default)
+    os.replace(tmp, path)
+
+
+def _json_default(o):
+    """Numpy scalars leak into counters from engine code; make them JSON."""
+    item = getattr(o, "item", None)
+    if item is not None:
+        return item()
+    raise TypeError(f"not JSON serializable: {type(o)!r}")

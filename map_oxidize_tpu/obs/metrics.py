@@ -1,0 +1,248 @@
+"""Counters, gauges, and lightweight histograms behind the seed
+``Metrics`` surface.
+
+The seed's ``utils.profiling.Metrics`` was a flat phase-timer/counter
+dict only the driver read.  :class:`MetricsRegistry` keeps that exact
+surface (``phase`` / ``count`` / ``set`` / ``summary``) so every existing
+consumer — bench.py, the spill tests, the CLI metrics log line — works
+unchanged, and adds what the scale targets need:
+
+* **counters** — monotonically accumulated (rows fed, spill bytes,
+  all_to_all payload bytes, demotion events);
+* **gauges** — last-value or watermark (``gauge_max``) readings
+  (host-RSS peak, HBM in use, registers filled);
+* **histograms** — p50/p95/max over per-event observations (per-block
+  feed latency, flush latency) with bounded memory: an exact
+  count/mean/min/max plus a deterministic stride-decimated sample set
+  for the quantiles.
+
+All mutating entry points take one lock; contention is negligible at the
+per-chunk/per-flush cadence the hot paths record at.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+
+class Histogram:
+    """Streaming summary of one observation series.
+
+    Exact ``count``/``sum``/``min``/``max``; quantiles come from a
+    deterministic sample: every ``stride``-th observation is kept, and
+    when the kept set reaches ``max_samples`` it is decimated 2:1 and the
+    stride doubles — bounded memory, no RNG (reproducible runs), and the
+    sample stays uniformly spread over the series.
+    """
+
+    __slots__ = ("count", "total", "min", "max", "_samples", "_stride",
+                 "_max_samples")
+
+    def __init__(self, max_samples: int = 8192):
+        self.count = 0
+        self.total = 0.0
+        self.min: float | None = None
+        self.max: float | None = None
+        self._samples: list[float] = []
+        self._stride = 1
+        self._max_samples = max_samples
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        self.count += 1
+        self.total += value
+        if self.min is None or value < self.min:
+            self.min = value
+        if self.max is None or value > self.max:
+            self.max = value
+        if self.count % self._stride == 0:
+            self._samples.append(value)
+            if len(self._samples) >= self._max_samples:
+                self._samples = self._samples[1::2]
+                self._stride *= 2
+
+    def quantile(self, q: float) -> float | None:
+        if not self._samples:
+            return self.max
+        s = sorted(self._samples)
+        idx = min(int(q * len(s)), len(s) - 1)
+        return s[idx]
+
+    def summary(self) -> dict:
+        return {
+            "count": self.count,
+            "mean": round(self.total / self.count, 6) if self.count else 0.0,
+            "p50": _round6(self.quantile(0.50)),
+            "p95": _round6(self.quantile(0.95)),
+            "max": _round6(self.max),
+        }
+
+
+def _round6(v):
+    return None if v is None else round(v, 6)
+
+
+class MetricsRegistry:
+    """Thread-safe registry of phases, counters, gauges, and histograms.
+
+    Drop-in for the seed ``Metrics``: ``phase``/``count``/``set`` keep
+    their semantics and ``summary()`` returns the same flat dict shape
+    (``time/<phase>_s`` keys, counters/gauges by plain name, the derived
+    ``records_per_sec``) plus flattened histogram quantiles.
+    """
+
+    def __init__(self):
+        self.phases: dict[str, float] = {}
+        self.counters: dict[str, float] = {}
+        self.gauges: dict[str, float] = {}
+        self.histograms: dict[str, Histogram] = {}
+        self._lock = threading.Lock()
+
+    # --- seed-compatible surface -----------------------------------------
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self.phases[name] = self.phases.get(name, 0.0) + dt
+
+    def count(self, name: str, delta: float = 1) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + delta
+
+    def set(self, name: str, value) -> None:
+        """Record a last-value gauge (the seed's ``set``)."""
+        with self._lock:
+            self.gauges[name] = value
+
+    # --- new surface ------------------------------------------------------
+
+    gauge = set
+
+    def gauge_max(self, name: str, value: float) -> None:
+        """Watermark gauge: keeps the maximum ever recorded (memory
+        peaks)."""
+        with self._lock:
+            if value > self.gauges.get(name, float("-inf")):
+                self.gauges[name] = value
+
+    def observe(self, name: str, value: float) -> None:
+        """Add one observation to the named histogram (created lazily)."""
+        with self._lock:
+            h = self.histograms.get(name)
+            if h is None:
+                h = self.histograms[name] = Histogram()
+            h.observe(value)
+
+    @contextlib.contextmanager
+    def timer(self, name: str):
+        """Time a block into the named histogram, in milliseconds."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(name, (time.perf_counter() - t0) * 1e3)
+
+    # --- export -----------------------------------------------------------
+
+    def summary(self) -> dict:
+        """Seed-compatible flat dict: phase wall-clocks, counters, gauges,
+        the derived throughput, and ``<hist>/{p50,p95,max,count}``
+        flattened histogram entries."""
+        with self._lock:
+            out = {f"time/{k}_s": round(v, 4) for k, v in self.phases.items()}
+            out.update(self.counters)
+            out.update(self.gauges)
+            merged = {**self.counters, **self.gauges}
+            hists = list(self.histograms.items())
+            phases = dict(self.phases)
+        for name, h in hists:
+            s = h.summary()
+            for stat in ("p50", "p95", "max", "count"):
+                out[f"{name}/{stat}"] = s[stat]
+        total_records = merged.get("records_in")
+        map_reduce_s = sum(
+            phases.get(p, 0.0) for p in ("map+reduce", "finalize")
+        )
+        if total_records and map_reduce_s > 0:
+            out["records_per_sec"] = round(total_records / map_reduce_s, 1)
+        return out
+
+    def to_dict(self) -> dict:
+        """Structured export (the ``--metrics-out`` document): phases,
+        counters, gauges, and full histogram summaries, unflattened."""
+        with self._lock:
+            return {
+                "phases_s": {k: round(v, 6) for k, v in self.phases.items()},
+                "counters": dict(self.counters),
+                "gauges": dict(self.gauges),
+                "histograms": {k: h.summary()
+                               for k, h in self.histograms.items()},
+            }
+
+
+# --- memory watermarks ----------------------------------------------------
+
+
+def sample_host_memory(registry: MetricsRegistry) -> None:
+    """Record host RSS watermarks: current ``VmRSS`` and the kernel's own
+    high-water ``VmHWM`` from ``/proc/self/status`` (Linux), falling back
+    to ``resource.getrusage`` peak RSS elsewhere.  Cheap (~µs), called at
+    phase boundaries — where residency peaks live (finalize fetches, sort
+    buffers, write staging)."""
+    rss = hwm = None
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    rss = int(line.split()[1]) * 1024
+                elif line.startswith("VmHWM:"):
+                    hwm = int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    if hwm is None:
+        try:
+            import resource
+
+            hwm = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+        except Exception:
+            return
+    if rss is not None:
+        registry.gauge_max("mem/host_rss_bytes", rss)
+    registry.gauge_max("mem/host_rss_peak_bytes", hwm)
+
+
+def sample_device_memory(registry: MetricsRegistry) -> None:
+    """Record HBM watermarks from ``device.memory_stats()`` for every
+    device jax has already initialized.  Deliberately a no-op when jax was
+    never imported by the job (pure-host paths must not pay backend
+    init), and tolerant of backends that expose no stats (CPU)."""
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return
+    try:
+        devices = jax.devices()
+    except Exception:
+        return
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        in_use = stats.get("bytes_in_use")
+        peak = stats.get("peak_bytes_in_use")
+        if in_use is not None:
+            registry.gauge_max(f"mem/device{d.id}_hbm_bytes", int(in_use))
+        if peak is not None:
+            registry.gauge_max(f"mem/device{d.id}_hbm_peak_bytes",
+                               int(peak))

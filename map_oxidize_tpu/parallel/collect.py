@@ -42,6 +42,7 @@ from map_oxidize_tpu.ops.hashing import SENTINEL
 from map_oxidize_tpu.parallel.mesh import SHARD_AXIS, make_mesh
 from map_oxidize_tpu.parallel.shuffle import _exchange
 from map_oxidize_tpu.runtime.engine import next_pow2
+from map_oxidize_tpu.utils.jax_compat import shard_map
 from map_oxidize_tpu.utils.logging import get_logger
 
 _log = get_logger(__name__)
@@ -67,6 +68,7 @@ class ShardedCollectEngine:
         self.block = S * self.bucket_cap
         self.max_rows = max_rows
         self.rows_fed = 0
+        self.obs = None                # obs.Obs injected by the driver
         self._stage: list = []
         self._staged = 0
         self._overflows: list = []     # replicated device scalars, one/flush
@@ -107,7 +109,7 @@ class ShardedCollectEngine:
                                 (bdl, s_dl))]
             return (*out, (c + live)[None], ovf)
 
-        self._route_append = jax.jit(jax.shard_map(
+        self._route_append = jax.jit(shard_map(
             _route_append, mesh=self.mesh,
             in_specs=(row2,) * 4 + (spec,) * 5,
             out_specs=(row2,) * 4 + (spec, P()),
@@ -119,7 +121,7 @@ class ShardedCollectEngine:
                          for b in (bh, bl, bdh, bdl))
 
         def _make_grow(pad):
-            return jax.jit(jax.shard_map(
+            return jax.jit(shard_map(
                 partial(_grow, pad=pad), mesh=self.mesh,
                 in_specs=(row2,) * 4, out_specs=(row2,) * 4),
                 donate_argnums=(0, 1, 2, 3))
@@ -130,7 +132,7 @@ class ShardedCollectEngine:
             s = lax.sort((hi[0], lo[0], dhi[0], dlo[0]), num_keys=4)
             return tuple(x[None] for x in s)
 
-        self._sort = jax.jit(jax.shard_map(
+        self._sort = jax.jit(shard_map(
             _sort, mesh=self.mesh,
             in_specs=(row2,) * 4,
             out_specs=(row2,) * 4,
@@ -199,6 +201,11 @@ class ShardedCollectEngine:
             return
         if self.rows_fed > self.max_rows:
             self._demote_to_host()
+            # the drained host engine was synced to rows_fed, which already
+            # counts this block's n; its feed re-adds n, so back it out
+            # here exactly like the already-demoted branch (ADVICE r5: the
+            # double-count triggered the host spill one block early)
+            self._host.rows_fed = self.rows_fed - n
             self._host.feed(out)
             return
         self._stage.append((out.hi, out.lo, vals))
@@ -222,7 +229,14 @@ class ShardedCollectEngine:
             "sharded collect crossed max_rows=%d; demoting the %d-shard "
             "device buffers to the host engine (disk-bucket spill)",
             self.max_rows, self.S)
+        if self.obs is not None:
+            self.obs.registry.count("demote/events")
+            self.obs.registry.count("demote/rows", self.rows_fed)
+            self.obs.tracer.instant("collect/demote_to_host",
+                                    rows=self.rows_fed, shards=self.S,
+                                    max_rows=self.max_rows)
         host = CollectEngine(self.config, max_rows=self.max_rows)
+        host.obs = self.obs  # the spill level keeps recording downstream
         host.sort_mode = "host"  # demotion target regardless of collect_sort
         host.device = None
         if self._buf is not None:
@@ -291,6 +305,17 @@ class ShardedCollectEngine:
             # worst case every live row landed on one shard
             self._cursor_ub += min(n, self.block)
             self._overflows.append(ovf)
+            if self.obs is not None:
+                from map_oxidize_tpu.parallel.shuffle import (
+                    exchange_payload_bytes,
+                )
+
+                self.obs.registry.count("shuffle/exchanges")
+                self.obs.registry.count("shuffle/rows_exchanged", n)
+                # doc planes ride as an 8-byte value row (dhi, dlo)
+                self.obs.registry.count(
+                    "shuffle/all_to_all_bytes",
+                    exchange_payload_bytes(S, self.bucket_cap, 8))
 
     def finalize(self):
         """Route + sort everything fed; returns host ``(keys_u64, docs_i64)``

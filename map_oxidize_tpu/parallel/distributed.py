@@ -52,6 +52,7 @@ from map_oxidize_tpu.ops.hashing import SENTINEL
 from map_oxidize_tpu.parallel.collect import (
     ShardedCollectEngine as ShardedCollectEngineBase,
 )
+from map_oxidize_tpu.utils.jax_compat import shard_map
 from map_oxidize_tpu.utils.logging import get_logger
 
 _log = get_logger(__name__)
@@ -278,7 +279,7 @@ def _make_flag_sum(mesh):
 
     from map_oxidize_tpu.parallel.mesh import SHARD_AXIS
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         partial(jax.lax.psum, axis_name=SHARD_AXIS),
         mesh=mesh, in_specs=P(SHARD_AXIS), out_specs=P()))
 
@@ -426,6 +427,7 @@ class DistributedResult:
     flag_rounds: int = 0              # lockstep psum rounds paid
     flag_s: float = 0.0               # ... and their total wall-clock
     resumed_chunks: int = 0           # chunks replayed from checkpoint
+    metrics: "dict | None" = None     # THIS process's registry summary
 
 
 def _local_chunks(config: JobConfig, proc: int, n_proc: int, doc_mode: bool,
@@ -476,10 +478,22 @@ def run_distributed_job(config: JobConfig, workload: str
     from map_oxidize_tpu.workloads.wordcount import make_wordcount
 
     config.validate()
-    if workload == "distinct":
-        return _run_distributed_distinct(config)
-    if workload == "kmeans":
+    if config.trace_out or config.progress:
+        # say so rather than silently dropping the flags: span tracing and
+        # the heartbeat are single-process features for now
+        _log.warning("--trace-out/--progress are not wired for "
+                     "multi-process jobs; distributed runs record "
+                     "counters only (--metrics-out)")
+    if workload in ("distinct", "kmeans"):
+        if config.metrics_out:
+            _log.warning("--metrics-out is not yet wired for distributed "
+                         "%s; no metrics file will be written", workload)
+        if workload == "distinct":
+            return _run_distributed_distinct(config)
         return _run_distributed_kmeans(config)
+    from map_oxidize_tpu.obs import MetricsRegistry
+
+    registry = MetricsRegistry()
     use_native = resolve_mapper(config, workload) == "native"
     doc_mode = workload == "invertedindex"
     if workload == "wordcount":
@@ -616,19 +630,24 @@ def run_distributed_job(config: JobConfig, workload: str
         top = [(h, words.get(h), int(df[order][j]))
                for j, h in enumerate(t_hashes)]
         if config.output_path:
-            from map_oxidize_tpu.io.writer import write_postings
+            # stream the partition straight from the CSR arrays: one
+            # term's doc slice is resident at a time, instead of boxing
+            # the whole partition into a dict of Python int lists first
+            # (ADVICE r5 — the blowup the CSR design exists to avoid)
+            from map_oxidize_tpu.io.writer import write_postings_stream
 
             names = partition_strings(uniq.tolist(), dictionary,
                                       engine.proc, P_)
             ends = np.append(bounds, keys.shape[0])
-            postings = {
-                names[int(h)]: np.sort(
-                    docs[ends[j]:ends[j + 1]]).tolist()
-                for j, h in enumerate(uniq.tolist())
-                if int(h) % P_ == engine.proc}
-            write_postings(
+            owned = sorted(
+                (names[int(h)], j) for j, h in enumerate(uniq.tolist())
+                if int(h) % P_ == engine.proc)  # term-byte output order
+            n_terms, n_bytes = write_postings_stream(
                 partition_output_path(config.output_path, engine.proc, P_),
-                postings)
+                ((term, np.sort(docs[ends[j]:ends[j + 1]]))
+                 for term, j in owned))
+            registry.count("dist/partition_terms_written", n_terms)
+            registry.count("dist/partition_bytes_written", n_bytes)
         result = DistributedResult(
             counts=None, top=top, n_keys=int(uniq.shape[0]),
             records=records, n_pairs=int(keys.shape[0]),
@@ -669,6 +688,17 @@ def run_distributed_job(config: JobConfig, workload: str
             resumed_chunks=resumed)
     if ckpt is not None:
         ckpt.finish(config.keep_intermediates)
+    registry.set("records_in", records)
+    registry.set("flag_rounds", flag_rounds)
+    result.metrics = registry.summary()
+    if config.metrics_out:
+        # one document per process (counters are per-process facts); the
+        # suffix keeps P writers off one file
+        from map_oxidize_tpu.obs import write_json_atomic
+
+        path = (config.metrics_out if P_ <= 1
+                else f"{config.metrics_out}.proc{engine.proc}")
+        write_json_atomic(path, registry.to_dict())
     _log.info("distributed %s: %d processes, %d local records, %d keys, "
               "%d lockstep flag rounds (%.3fs)", workload, P_, records,
               result.n_keys, flag_rounds, flag_s)

@@ -123,6 +123,22 @@ class ShardedReduceEngine(StreamingEngineBase):
             *self._acc, self._overflow, hi, lo, vals
         )
         self._n_live_ub += incoming
+        if self.obs is not None:
+            from map_oxidize_tpu.parallel.shuffle import (
+                exchange_payload_bytes,
+            )
+
+            reg = self.obs.registry
+            reg.count("shuffle/exchanges")
+            reg.count("shuffle/rows_exchanged", hi.shape[0])
+            reg.count("shuffle/all_to_all_bytes", exchange_payload_bytes(
+                self.S, self.bucket_cap,
+                int(self.value_dtype.itemsize
+                    * max(1, int(np.prod(self.value_shape, dtype=np.int64)))
+                    )))
+            # the per-merge psum payloads: the [S] unique counts + the [S]
+            # overflow counter, int32 each, replicated over S shards
+            reg.count("shuffle/psum_bytes", 2 * 4 * self.S * self.S)
 
     def export_state(self) -> dict:
         """Host snapshot of the sharded reduce state (see the single-device

@@ -24,6 +24,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from map_oxidize_tpu.parallel.mesh import SHARD_AXIS, make_mesh
+from map_oxidize_tpu.utils.jax_compat import shard_map
 
 
 def make_fit_fn(mesh, k: int, d: int, loop_iters: int,
@@ -51,7 +52,7 @@ def make_fit_fn(mesh, k: int, d: int, loop_iters: int,
 
         return lax.fori_loop(0, loop_iters, step, c)
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         fit, mesh=mesh,
         in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P()),
         out_specs=P(),

@@ -40,6 +40,17 @@ from jax.sharding import PartitionSpec as P
 from map_oxidize_tpu.ops.hashing import SENTINEL
 from map_oxidize_tpu.ops.segment_reduce import reduce_pairs
 from map_oxidize_tpu.parallel.mesh import SHARD_AXIS
+from map_oxidize_tpu.utils.jax_compat import shard_map
+
+
+def exchange_payload_bytes(num_shards: int, bucket_cap: int,
+                           value_row_bytes: int) -> int:
+    """Bytes one full exchange moves over ICI/DCN: every shard sends a
+    ``[S, cap]`` buffer of (hi, lo, value) planes, so the global payload
+    is ``S * S * cap`` rows of ``8 + value_row_bytes`` each.  A host-side
+    accounting identity for the metrics registry — the collective itself
+    is inside XLA and can't self-report."""
+    return num_shards * num_shards * bucket_cap * (8 + value_row_bytes)
 
 
 def bucket_of(hi: jnp.ndarray, lo: jnp.ndarray, num_shards: int) -> jnp.ndarray:
@@ -194,7 +205,7 @@ def build_sharded_ops(mesh, combine: str = "sum", bucket_cap: int = 0,
         bucket_cap = min(batch_per_shard, 2 * (-(-batch_per_shard // S)) + 16)
 
     spec = P(SHARD_AXIS)
-    merge = jax.shard_map(
+    merge = shard_map(
         partial(_merge_step, num_shards=S, cap=bucket_cap, combine=combine),
         mesh=mesh,
         in_specs=(spec,) * 7,
@@ -207,7 +218,7 @@ def build_sharded_ops(mesh, combine: str = "sum", bucket_cap: int = 0,
         # check_vma=False: the result of top_k over an all_gather IS
         # replicated, but shard_map's static replication checker can't prove
         # it through the take/top_k composition.
-        f = jax.shard_map(
+        f = shard_map(
             partial(_topk_step, k_local=k_local, k_final=k_final),
             mesh=mesh,
             in_specs=(spec, spec, spec),
@@ -234,7 +245,7 @@ def build_sharded_ops(mesh, combine: str = "sum", bucket_cap: int = 0,
                 jnp.concatenate([v, p_v]),
             )
 
-        f = jax.shard_map(_grow, mesh=mesh, in_specs=(spec,) * 3,
+        f = shard_map(_grow, mesh=mesh, in_specs=(spec,) * 3,
                           out_specs=(spec,) * 3)
         return jax.jit(f, donate_argnums=(0, 1, 2))(acc_hi, acc_lo, acc_vals)
 

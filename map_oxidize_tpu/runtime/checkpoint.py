@@ -62,9 +62,11 @@ def _arrays_to_dict(hashes, lens, blob) -> HashDictionary:
 class CheckpointStore:
     """Spill/replay of per-chunk map outputs under one directory."""
 
-    def __init__(self, directory: str, meta: dict):
+    def __init__(self, directory: str, meta: dict, registry=None):
         self.dir = directory
         self.meta = dict(meta, version=_FORMAT_VERSION)
+        #: optional obs.MetricsRegistry — spill/replay volume counters
+        self.registry = registry
         os.makedirs(self.dir, exist_ok=True)
         self._meta_path = os.path.join(self.dir, "meta.json")
         existing = self._read_meta()
@@ -169,6 +171,14 @@ class CheckpointStore:
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, self._chunk_path(idx))
+            if self.registry is not None:
+                self.registry.count("checkpoint/chunks_saved")
+                try:
+                    self.registry.count(
+                        "checkpoint/bytes_saved",
+                        os.path.getsize(self._chunk_path(idx)))
+                except OSError:
+                    pass
         except BaseException:
             try:
                 os.unlink(tmp)
@@ -199,6 +209,8 @@ class CheckpointStore:
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, self._snapshot_path)
+            if self.registry is not None:
+                self.registry.count("checkpoint/snapshots_saved")
         except BaseException:
             try:
                 os.unlink(tmp)
@@ -275,6 +287,8 @@ class CheckpointStore:
                     except OSError:
                         pass
                 return
+            if self.registry is not None:
+                self.registry.count("checkpoint/chunks_replayed")
             yield item
 
     # --- lifecycle ------------------------------------------------------

@@ -93,6 +93,7 @@ class CollectEngine:
         self._staged = 0
         self.rows_fed = 0
         self.peak_staged_rows = 0           # observability + test oracle
+        self.obs = None                     # obs.Obs injected by the driver
         self._spill = None                  # runtime.spill.BucketFiles
         self.spilled_rows = 0
 
@@ -152,6 +153,11 @@ class CollectEngine:
             "pair collect crossed max_rows=%d; spilling to %d disk "
             "buckets under %s", self.max_rows,
             1 << self.SPILL_BUCKETS_BITS, self._spill.path)
+        if self.obs is not None:
+            self.obs.registry.count("spill/begin_events")
+            self.obs.tracer.instant("collect/spill_begin",
+                                    max_rows=self.max_rows,
+                                    rows_fed=self.rows_fed)
         keys, docs, _owned = self._host_columns()
         self._spill_pairs(keys, docs)
 
@@ -165,6 +171,9 @@ class CollectEngine:
         rec["d"] = docs[order]
         self._spill.write_partitioned("kd", rec, counts, offs)
         self.spilled_rows += int(keys.shape[0])
+        if self.obs is not None:
+            self.obs.registry.count("spill/rows", int(keys.shape[0]))
+            self.obs.registry.count("spill/bytes", int(rec.nbytes))
 
     def finalize_spilled_csr(self):
         """Bucket-by-bucket CSR finalize for spilled runs: each bucket is

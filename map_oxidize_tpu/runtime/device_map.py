@@ -38,8 +38,9 @@ from map_oxidize_tpu.runtime.engine import (
     DeviceReduceEngine,
     next_pow2,
 )
+from map_oxidize_tpu.utils.jax_compat import shard_map
+from map_oxidize_tpu.obs import Obs
 from map_oxidize_tpu.utils.logging import get_logger
-from map_oxidize_tpu.utils.profiling import Metrics
 
 _log = get_logger(__name__)
 
@@ -140,7 +141,8 @@ def run_sharded_device_job(config: JobConfig, ngram: int = 1) -> JobResult:
     from map_oxidize_tpu.parallel.mesh import SHARD_AXIS
 
     config.validate()
-    metrics = Metrics()
+    obs = Obs.from_config(config)
+    metrics = obs.registry
     N = config.chunk_bytes
     max_tokens = N // 2 + 1
     out_keys = min(config.device_chunk_keys, max_tokens)  # kernel clamps
@@ -154,12 +156,13 @@ def run_sharded_device_job(config: JobConfig, ngram: int = 1) -> JobResult:
     S = mesh.shape[SHARD_AXIS]
     engine = ShardedReduceEngine(
         replace(config, batch_size=S * out_keys), SumReducer(), mesh=mesh)
+    engine.obs = obs
     pk = _power_tables(N)
     rep_spec = NamedSharding(mesh, P())
     row_spec = NamedSharding(mesh, P(SHARD_AXIS))
     tables = tuple(jax.device_put(t, rep_spec) for t in pk)
 
-    group_fn = jax.jit(jax.shard_map(
+    group_fn = jax.jit(shard_map(
         lambda chunk, a, b, c, d: tokenize_count_core(
             chunk, a, b, c, d, max_tokens=max_tokens, out_keys=out_keys,
             fetch_keys=fetch, ngram=ngram),
@@ -172,7 +175,8 @@ def run_sharded_device_job(config: JobConfig, ngram: int = 1) -> JobResult:
     pending: tuple | None = None
     n_chunks = 0
 
-    ckpt = _open_snapshot(config, f"device-map-sharded-ngram{ngram}", S)
+    ckpt = _open_snapshot(config, f"device-map-sharded-ngram{ngram}", S,
+                          registry=metrics)
 
     def _set_dict(d, records):
         # the snapshot stores the UNION dictionary; shard 0 carries it on
@@ -201,15 +205,23 @@ def run_sharded_device_job(config: JobConfig, ngram: int = 1) -> JobResult:
             engine.export_state(), union, off, n_chunks,
             {"records_in": np.int64(sum(d.records_in for d in dicts))})
 
-    with metrics.phase("map+reduce"):
+    with obs.phase("map+reduce"):
         group: list[bytes] = []
         off = resume_off
         groups_done = 0
+        hb_records = sum(d.records_in for d in dicts)
         for chunk in iter_chunks_capped(config.input_path, config.chunk_bytes,
                                         resume_off):
             group.append(bytes(chunk))
             n_chunks += 1
             off += len(chunk)
+            if obs.heartbeat is not None:
+                # rows = tokenized-record delta (one group behind — the
+                # dictionary fetch is pipelined); bytes drive the percent
+                total = sum(d.records_in for d in dicts)
+                obs.heartbeat.update(rows=total - hb_records,
+                                     bytes_done=off)
+                hb_records = total
             if len(group) < S:
                 continue
             pending = _dispatch_group(group, group_fn, N, tables, engine,
@@ -229,8 +241,11 @@ def run_sharded_device_job(config: JobConfig, ngram: int = 1) -> JobResult:
                                       row_spec, pending, _process_group)
         if pending is not None:
             _process_group(*pending)
+        if obs.heartbeat is not None:  # tail records the pipeline lagged
+            obs.heartbeat.update(
+                rows=sum(d.records_in for d in dicts) - hb_records)
 
-    with metrics.phase("finalize"):
+    with obs.phase("finalize"):
         dictionary = dicts[0].dictionary
         for d in dicts[1:]:
             dictionary.update(d.dictionary)
@@ -245,7 +260,7 @@ def run_sharded_device_job(config: JobConfig, ngram: int = 1) -> JobResult:
             f"{records_in} records but counts sum to {total}"
         )
 
-    with metrics.phase("write"):
+    with obs.phase("write"):
         if config.output_path:
             write_final_result(config.output_path, counts.items())
 
@@ -256,7 +271,8 @@ def run_sharded_device_job(config: JobConfig, ngram: int = 1) -> JobResult:
     metrics.set("distinct_keys", len(counts))
     metrics.set("chunks", n_chunks)
     metrics.set("shards", S)
-    result = JobResult(counts=counts, top=top, metrics=metrics.summary())
+    summary, trace = obs.finish(config)
+    result = JobResult(counts=counts, top=top, metrics=summary, trace=trace)
     if config.metrics:
         _log.info("metrics: %s", result.metrics)
     return result
@@ -282,7 +298,8 @@ def _dispatch_group(group, group_fn, chunk_bytes, tables, engine, row_spec,
 _SNAP_EVERY = 16
 
 
-def _open_snapshot(config: JobConfig, workload_tag: str, num_shards: int):
+def _open_snapshot(config: JobConfig, workload_tag: str, num_shards: int,
+                   registry=None):
     """Device-map checkpointing: map outputs never exist on the host here,
     so the resumable artifact is a periodic SNAPSHOT of the reduced state
     (engine accumulator + dictionary + input byte offset) rather than the
@@ -299,7 +316,8 @@ def _open_snapshot(config: JobConfig, workload_tag: str, num_shards: int):
         CheckpointStore.job_meta(
             config, workload_tag,
             extra={"num_shards": num_shards,
-                   "device_chunk_keys": config.device_chunk_keys}))
+                   "device_chunk_keys": config.device_chunk_keys}),
+        registry=registry)
 
 
 def _resume_snapshot(ckpt, engine, set_dictionary) -> tuple[int, int]:
@@ -322,13 +340,16 @@ def _resume_snapshot(ckpt, engine, set_dictionary) -> tuple[int, int]:
 def run_device_wordcount_job(config: JobConfig, ngram: int = 1) -> JobResult:
     """Word/n-gram count with the map phase on device (single chip)."""
     config.validate()
-    metrics = Metrics()
+    obs = Obs.from_config(config)
+    metrics = obs.registry
     engine = DeviceReduceEngine(config, SumReducer())
+    engine.obs = obs
     tok = DeviceTokenizer(config.chunk_bytes, config.device_chunk_keys,
                           device=engine.device, ngram=ngram)
     dicts = _DictBuilder(tok.out_keys, tok.fetch_keys, ngram)
 
-    ckpt = _open_snapshot(config, f"device-map-ngram{ngram}", 1)
+    ckpt = _open_snapshot(config, f"device-map-ngram{ngram}", 1,
+                          registry=metrics)
 
     def _set_dict(d, records):
         dicts.dictionary = d
@@ -339,7 +360,8 @@ def run_device_wordcount_job(config: JobConfig, ngram: int = 1) -> JobResult:
 
     pending: tuple | None = None
     off = resume_off
-    with metrics.phase("map+reduce"):
+    hb_records = dicts.records_in
+    with obs.phase("map+reduce"):
         for chunk in iter_chunks_capped(config.input_path, config.chunk_bytes,
                                         resume_off):
             outs = tok.map_chunk_device(chunk)          # async upload + kernel
@@ -349,6 +371,12 @@ def run_device_wordcount_job(config: JobConfig, ngram: int = 1) -> JobResult:
             pending = (chunk, outs)
             n_chunks += 1
             off += len(chunk)
+            if obs.heartbeat is not None:
+                # rows = tokenized-record delta (one chunk behind — the
+                # dictionary fetch is pipelined); bytes drive the percent
+                obs.heartbeat.update(rows=dicts.records_in - hb_records,
+                                     bytes_done=off)
+                hb_records = dicts.records_in
             # the dictionary length is the exact global distinct-key count
             # (one chunk behind) — feed it back so capacity growth rarely
             # needs its own device sync
@@ -362,8 +390,10 @@ def run_device_wordcount_job(config: JobConfig, ngram: int = 1) -> JobResult:
                     {"records_in": np.int64(dicts.records_in)})
         if pending is not None:
             dicts.process(*pending)
+        if obs.heartbeat is not None:  # tail records the pipeline lagged
+            obs.heartbeat.update(rows=dicts.records_in - hb_records)
 
-    with metrics.phase("finalize"):
+    with obs.phase("finalize"):
         counts = _readback(engine, dicts.dictionary)
         top = counts.top_k(config.top_k)
 
@@ -374,7 +404,7 @@ def run_device_wordcount_job(config: JobConfig, ngram: int = 1) -> JobResult:
             f"{dicts.records_in} tokens but counts sum to {total}"
         )
 
-    with metrics.phase("write"):
+    with obs.phase("write"):
         if config.output_path:
             write_final_result(config.output_path, counts.items())
 
@@ -384,7 +414,8 @@ def run_device_wordcount_job(config: JobConfig, ngram: int = 1) -> JobResult:
     metrics.set("records_in", dicts.records_in)
     metrics.set("distinct_keys", len(counts))
     metrics.set("chunks", n_chunks)
-    result = JobResult(counts=counts, top=top, metrics=metrics.summary())
+    summary, trace = obs.finish(config)
+    result = JobResult(counts=counts, top=top, metrics=summary, trace=trace)
     if config.metrics:
         _log.info("metrics: %s", result.metrics)
     return result

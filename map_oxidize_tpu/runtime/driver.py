@@ -10,6 +10,7 @@ config, metrics, retries, checkpointing hooks, and deterministic output.
 
 from __future__ import annotations
 
+import time
 from collections.abc import Mapping
 from dataclasses import dataclass, field
 
@@ -19,11 +20,11 @@ from map_oxidize_tpu.api import Mapper, Reducer
 from map_oxidize_tpu.config import JobConfig
 from map_oxidize_tpu.io.splitter import iter_chunks, plan_chunks, split_round_robin
 from map_oxidize_tpu.io.writer import format_top_words, write_final_result
+from map_oxidize_tpu.obs import Obs
 from map_oxidize_tpu.ops.hashing import SENTINEL, HashDictionary, join_u64
 from map_oxidize_tpu.runtime.engine import DeviceReduceEngine, StreamingEngineBase
 from map_oxidize_tpu.runtime.executor import run_map_phase
 from map_oxidize_tpu.utils.logging import get_logger
-from map_oxidize_tpu.utils.profiling import Metrics
 
 _log = get_logger(__name__)
 
@@ -33,11 +34,13 @@ class JobResult:
     """What the reference reports (final_result.txt + top-10 stdout,
     main.rs:25-28), plus metrics.  ``counts`` is a read-only Mapping
     (:class:`LazyCounts`): array-backed until a consumer needs strings for
-    every key."""
+    every key.  ``trace`` carries the Chrome trace-event list when the job
+    ran with tracing enabled (``config.trace_out``), else None."""
 
     counts: "Mapping[bytes, int]"
     top: list[tuple[bytes, int]]
     metrics: dict = field(default_factory=dict)
+    trace: list | None = None
 
     def top_report(self, k: int) -> str:
         return format_top_words(self.top, k)
@@ -252,12 +255,14 @@ def run_wordcount_job(config: JobConfig, mapper: Mapper, reducer: Reducer,
     atomically and a re-run replays the spilled prefix instead of re-mapping
     it (see :mod:`map_oxidize_tpu.runtime.checkpoint`)."""
     config.validate()
-    metrics = Metrics()
+    obs = Obs.from_config(config)
+    metrics = obs.registry
 
     engine = make_engine(config, reducer,
                          value_shape=mapper.value_shape,
                          value_dtype=mapper.value_dtype,
                          wide_keys=getattr(mapper, "wide_keys", False))
+    engine.obs = obs
 
     # hash-only map mode: with the host collect-reduce engine the map needs
     # neither per-chunk combining nor key strings (the one final sort dedups;
@@ -282,7 +287,7 @@ def run_wordcount_job(config: JobConfig, mapper: Mapper, reducer: Reducer,
     records_in = 0
     n_chunks = 0
 
-    def _ingest(out) -> None:
+    def _ingest(out, next_off: int | None = None) -> None:
         nonlocal records_in, n_chunks
         dictionary.update(out.dictionary)
         records_in += out.records_in
@@ -293,7 +298,15 @@ def run_wordcount_job(config: JobConfig, mapper: Mapper, reducer: Reducer,
             # self-tightens with an amortized flush when pending deltas
             # could be duplicate-dominated (see HashDictionary.upper_bound).
             engine.hint_total_keys(dictionary.upper_bound())
-        engine.feed(out)
+        t0 = time.perf_counter()
+        with obs.feed_span(rows=len(out)):
+            engine.feed(out)
+        metrics.observe("feed_block_ms", (time.perf_counter() - t0) * 1e3)
+        if obs.heartbeat is not None:
+            # one update carrying BOTH the rows and the chunk's end offset:
+            # a beat fired here must not read a percent that lags the rows
+            # by one chunk (single-chunk jobs would report 0% throughout)
+            obs.heartbeat.update(rows=out.records_in, bytes_done=next_off)
 
     # --- replay checkpointed chunks (resume), if any
     ckpt = None
@@ -304,8 +317,9 @@ def run_wordcount_job(config: JobConfig, mapper: Mapper, reducer: Reducer,
 
         ckpt = CheckpointStore(
             config.checkpoint_dir,
-            CheckpointStore.job_meta(config, workload, hash_only=hash_only))
-        with metrics.phase("replay"):
+            CheckpointStore.job_meta(config, workload, hash_only=hash_only),
+            registry=metrics)
+        with obs.phase("replay"):
             for idx, out, next_off in ckpt.replay():
                 _ingest(out)
                 resume_k, resume_off = idx + 1, next_off
@@ -318,7 +332,7 @@ def run_wordcount_job(config: JobConfig, mapper: Mapper, reducer: Reducer,
     # --- split (plan only; chunks stream lazily — contrast main.rs:16/36-51)
     native_file_iter = None
     offsets: dict[int, int] = {}  # global chunk idx -> end byte offset
-    with metrics.phase("split"):
+    with obs.phase("split"):
         if config.num_chunks > 0:
             # round-robin compat mode: chunk identity is the index, not a
             # byte offset — resume skips the first resume_k chunks
@@ -343,10 +357,10 @@ def run_wordcount_job(config: JobConfig, mapper: Mapper, reducer: Reducer,
                     resume_off, offsets, resume_k)
 
     # --- map + reduce, fused streaming phase (main.rs:19-22 were barriered)
-    with metrics.phase("map+reduce"):
+    with obs.phase("map+reduce"):
         if native_file_iter is not None:
             for i, (out, next_off) in enumerate(native_file_iter):
-                _ingest(out)
+                _ingest(out, next_off)
                 if ckpt is not None:
                     ckpt.save(resume_k + i, out, next_off)
         else:
@@ -354,13 +368,13 @@ def run_wordcount_job(config: JobConfig, mapper: Mapper, reducer: Reducer,
                 chunks, mapper, config.num_map_workers, config.max_retries
             )
             for idx, out in outputs:
-                _ingest(out)
+                gidx = resume_k + idx
+                _ingest(out, offsets.get(gidx))
                 if ckpt is not None:
-                    gidx = resume_k + idx
                     ckpt.save(gidx, out, offsets.get(gidx, -1))
 
     # --- finalize on device; read back to host strings
-    with metrics.phase("finalize"):
+    with obs.phase("finalize"):
         counts = _readback(engine, dictionary)
         top = counts.top_k(config.top_k)
 
@@ -377,7 +391,7 @@ def run_wordcount_job(config: JobConfig, mapper: Mapper, reducer: Reducer,
             )
 
     # --- write final result (deterministic, atomic — fixes main.rs:170-182)
-    with metrics.phase("write"):
+    with obs.phase("write"):
         if config.output_path:
             write_final_result(config.output_path, counts.items())
 
@@ -390,7 +404,8 @@ def run_wordcount_job(config: JobConfig, mapper: Mapper, reducer: Reducer,
     metrics.set("distinct_keys", len(counts))
     metrics.set("chunks", n_chunks)
     metrics.set("device_rows_fed", engine.rows_fed)
-    result = JobResult(counts=counts, top=top, metrics=metrics.summary())
+    summary, trace = obs.finish(config)
+    result = JobResult(counts=counts, top=top, metrics=summary, trace=trace)
     if config.metrics:
         _log.info("metrics: %s", result.metrics)
     return result
@@ -404,6 +419,7 @@ class InvertedIndexResult:
 
     postings: "Mapping[bytes, list[int]]"
     metrics: dict = field(default_factory=dict)
+    trace: list | None = None
 
     def top_report(self, k: int) -> str:
         if hasattr(self.postings, "top_by_df"):
@@ -432,7 +448,8 @@ def run_inverted_index_job(config: JobConfig) -> InvertedIndexResult:
     )
 
     config.validate()
-    metrics = Metrics()
+    obs = Obs.from_config(config)
+    metrics = obs.registry
     mapper = make_inverted_index(config.tokenizer, config.use_native)
     if effective_num_shards(config) > 1:
         from map_oxidize_tpu.parallel.collect import ShardedCollectEngine
@@ -446,16 +463,22 @@ def run_inverted_index_job(config: JobConfig) -> InvertedIndexResult:
         from map_oxidize_tpu.runtime.collect import CollectEngine
 
         engine = CollectEngine(config, **collect_engine_kw(config))
+    engine.obs = obs
     dictionary = HashDictionary()
     records_in = 0
     n_chunks = 0
 
-    def _ingest(out) -> None:
+    def _ingest(out, next_off: int | None = None) -> None:
         nonlocal records_in, n_chunks
         dictionary.update(out.dictionary)
         records_in += out.records_in
         n_chunks += 1
-        engine.feed(out)
+        t0 = time.perf_counter()
+        with obs.feed_span(rows=len(out)):
+            engine.feed(out)
+        metrics.observe("feed_block_ms", (time.perf_counter() - t0) * 1e3)
+        if obs.heartbeat is not None:
+            obs.heartbeat.update(rows=out.records_in, bytes_done=next_off)
 
     # --- replay checkpointed chunks (resume), if any — the CollectEngine
     # feed is append-only, so per-chunk spill+replay maps exactly like the
@@ -468,8 +491,9 @@ def run_inverted_index_job(config: JobConfig) -> InvertedIndexResult:
 
         ckpt = CheckpointStore(
             config.checkpoint_dir,
-            CheckpointStore.job_meta(config, "invertedindex"))
-        with metrics.phase("replay"):
+            CheckpointStore.job_meta(config, "invertedindex"),
+            registry=metrics)
+        with obs.phase("replay"):
             for idx, out, next_off in ckpt.replay():
                 _ingest(out)
                 resume_k, resume_off = idx + 1, next_off
@@ -477,7 +501,7 @@ def run_inverted_index_job(config: JobConfig) -> InvertedIndexResult:
             _log.info("resumed %d checkpointed chunks (input offset %d)",
                       resume_k, resume_off)
 
-    with metrics.phase("map+collect"):
+    with obs.phase("map+collect"):
         _, chunk_bytes = plan_chunks(config.input_path, config.chunk_bytes)
         it = mapper.iter_file_docs(config.input_path, chunk_bytes, resume_off)
         if it is None:
@@ -491,11 +515,11 @@ def run_inverted_index_job(config: JobConfig) -> InvertedIndexResult:
                     yield mapper.map_docs(chunk, off - len(chunk)), off
             it = _host_iter()
         for i, (out, next_off) in enumerate(it):
-            _ingest(out)
+            _ingest(out, next_off)
             if ckpt is not None:
                 ckpt.save(resume_k + i, out, next_off)
 
-    with metrics.phase("sort+postings"):
+    with obs.phase("sort+postings"):
         if getattr(engine, "spilled", False):
             # beyond-RAM run: bucket-by-bucket CSR with an on-disk doc
             # column (memmap) — Postings answers everything lazily, so the
@@ -530,15 +554,16 @@ def run_inverted_index_job(config: JobConfig) -> InvertedIndexResult:
                 postings = postings_from_sorted(keys, docs, dictionary)
             metrics.set("grouped_finalize", csr is not None)
 
-    return _finish_inverted_index(config, metrics, postings, ckpt,
+    return _finish_inverted_index(config, obs, postings, ckpt,
                                   records_in, n_chunks)
 
 
-def _finish_inverted_index(config, metrics, postings, ckpt, records_in,
+def _finish_inverted_index(config, obs, postings, ckpt, records_in,
                            n_chunks) -> "InvertedIndexResult":
     """Shared tail of the inverted-index job (in-RAM and spilled CSR
     paths): write, checkpoint cleanup, metrics, result."""
-    with metrics.phase("write"):
+    metrics = obs.registry
+    with obs.phase("write"):
         if config.output_path:
             from map_oxidize_tpu.io.writer import write_postings
 
@@ -551,7 +576,9 @@ def _finish_inverted_index(config, metrics, postings, ckpt, records_in,
     metrics.set("pairs", int(postings.n_pairs))
     metrics.set("distinct_terms", len(postings))
     metrics.set("chunks", n_chunks)
-    result = InvertedIndexResult(postings=postings, metrics=metrics.summary())
+    summary, trace = obs.finish(config)
+    result = InvertedIndexResult(postings=postings, metrics=summary,
+                                 trace=trace)
     if config.metrics:
         _log.info("metrics: %s", result.metrics)
     return result
@@ -564,6 +591,7 @@ class KMeansResult:
 
     centroids: np.ndarray
     metrics: dict = field(default_factory=dict)
+    trace: list | None = None
 
     def top_report(self, k: int) -> str:  # CLI-facing summary
         return (f"k-means: {self.centroids.shape[0]} centroids, "
@@ -652,7 +680,8 @@ def run_kmeans_job(config: JobConfig, centroids: np.ndarray | None = None
     )
 
     config.validate()
-    metrics = Metrics()
+    obs = Obs.from_config(config)
+    metrics = obs.registry
     pts = np.load(config.input_path, mmap_mode="r")
     if pts.ndim != 2:
         raise ValueError(f"k-means input must be (n, d); got {pts.shape}")
@@ -745,7 +774,22 @@ def run_kmeans_job(config: JobConfig, centroids: np.ndarray | None = None
         def _save(done: int, c: np.ndarray) -> None:
             store.save_snapshot({"centroids": np.asarray(c, np.float32)},
                                 HashDictionary(), done, done)
-    with metrics.phase("iterate"):
+
+    def _iter_done(i: int, c: np.ndarray | None = None) -> None:
+        """Per-iteration hook shared by every k-means path: heartbeat tick
+        (iteration fraction, since bytes mean nothing here) + optional
+        snapshot.  Passed as ``on_iter`` only when a consumer exists —
+        the callback forces a per-iteration centroid fetch the
+        no-checkpoint no-progress run must not pay."""
+        if obs.heartbeat is not None:
+            obs.heartbeat.update(
+                rows=int(n),
+                fraction=min((start_iter + i) / config.kmeans_iters, 1.0))
+        if store is not None and c is not None:
+            _save(start_iter + i, c)
+
+    want_iter_cb = store is not None or obs.heartbeat is not None
+    with obs.phase("iterate"):
         remaining = config.kmeans_iters - start_iter
         if remaining <= 0:
             # snapshot already covers every requested iteration; the
@@ -780,13 +824,11 @@ def run_kmeans_job(config: JobConfig, centroids: np.ndarray | None = None
                 device=pick_device(config.backend),
                 precision=config.kmeans_precision,
                 timings=timings,
-                on_iter=((lambda i, c: _save(start_iter + i, c))
-                         if store else None))
+                on_iter=_iter_done if want_iter_cb else None)
             for tk, tv in timings.items():
                 metrics.set(f"time/{tk}", round(tv, 4))
         elif device_mode:
-            on_iter = ((lambda i, c: _save(start_iter + i, c))
-                       if store else None)
+            on_iter = _iter_done if want_iter_cb else None
             if n_shards > 1:
                 from map_oxidize_tpu.parallel.kmeans import kmeans_fit_sharded
 
@@ -819,9 +861,10 @@ def run_kmeans_job(config: JobConfig, centroids: np.ndarray | None = None
                 centroids = kmeans_iteration(
                     engine, centroids,
                     iter_point_chunks(config.input_path, rows))
-                if store:
-                    _save(it + 1, centroids)
-    with metrics.phase("write"):
+                if want_iter_cb:
+                    _iter_done(it + 1 - start_iter,
+                               centroids if store else None)
+    with obs.phase("write"):
         if config.output_path:
             from map_oxidize_tpu.workloads.kmeans import write_centroids
 
@@ -843,7 +886,8 @@ def run_kmeans_job(config: JobConfig, centroids: np.ndarray | None = None
     metrics.set("iters", start_iter + ran_iters)
     if start_iter:
         metrics.set("resumed_iters", start_iter)
-    result = KMeansResult(centroids=centroids, metrics=metrics.summary())
+    summary, trace = obs.finish(config)
+    result = KMeansResult(centroids=centroids, metrics=summary, trace=trace)
     if config.metrics:
         _log.info("metrics: %s", result.metrics)
     return result
@@ -858,6 +902,7 @@ class DistinctResult:
     estimate: float
     registers: np.ndarray
     metrics: dict = field(default_factory=dict)
+    trace: list | None = None
 
     def top_report(self, k: int) -> str:  # CLI-facing summary
         filled = int(np.count_nonzero(self.registers))
@@ -881,7 +926,8 @@ def run_distinct_job(config: JobConfig) -> DistinctResult:
     )
 
     config.validate()
-    metrics = Metrics()
+    obs = Obs.from_config(config)
+    metrics = obs.registry
     p = config.hll_precision
     m = 1 << p
     use_native = _rt.resolve_mapper(config, "distinct") == "native"
@@ -898,22 +944,28 @@ def run_distinct_job(config: JobConfig) -> DistinctResult:
     if effective_num_shards(config) > 1:
         engine = make_engine(config, MaxReducer(), value_shape=(),
                              value_dtype=np.int32)
+        engine.obs = obs
         engine.hint_total_keys(m)
 
     records_in = 0
     n_chunks = 0
 
-    def _ingest(out) -> None:
+    def _ingest(out, next_off: int | None = None) -> None:
         nonlocal records_in, n_chunks
         records_in += out.records_in
         n_chunks += 1
+        t0 = time.perf_counter()
         if engine is not None:
-            engine.feed(out)
+            with obs.feed_span(rows=len(out)):
+                engine.feed(out)
         else:
             # lo is flatnonzero output — unique per chunk, so fancy-index
             # max is exact (and ~10x ufunc.at)
             idx = out.lo.astype(np.int64)
             host_regs[idx] = np.maximum(host_regs[idx], out.values)
+        metrics.observe("feed_block_ms", (time.perf_counter() - t0) * 1e3)
+        if obs.heartbeat is not None:
+            obs.heartbeat.update(rows=out.records_in, bytes_done=next_off)
 
     # --- replay checkpointed chunks (resume), if any — registers are
     # ordinary (key, value) rows, so the standard per-chunk spill applies
@@ -926,13 +978,14 @@ def run_distinct_job(config: JobConfig) -> DistinctResult:
         ckpt = CheckpointStore(
             config.checkpoint_dir,
             CheckpointStore.job_meta(config, "distinct",
-                                     extra={"hll_precision": p}))
-        with metrics.phase("replay"):
+                                     extra={"hll_precision": p}),
+            registry=metrics)
+        with obs.phase("replay"):
             for idx, out, next_off in ckpt.replay():
                 _ingest(out)
                 resume_k, resume_off = idx + 1, next_off
 
-    with metrics.phase("split"):
+    with obs.phase("split"):
         _, chunk_bytes = plan_chunks(config.input_path, config.chunk_bytes)
         file_iter = mapper.map_file(config.input_path, chunk_bytes,
                                     resume_off)
@@ -942,22 +995,22 @@ def run_distinct_job(config: JobConfig) -> DistinctResult:
                 iter_chunks(config.input_path, chunk_bytes, resume_off),
                 resume_off, offsets, resume_k)
 
-    with metrics.phase("map+reduce"):
+    with obs.phase("map+reduce"):
         if file_iter is not None:
             for i, (out, next_off) in enumerate(file_iter):
-                _ingest(out)
+                _ingest(out, next_off)
                 if ckpt is not None:
                     ckpt.save(resume_k + i, out, next_off)
         else:
             for idx, out in run_map_phase(chunks, mapper,
                                           config.num_map_workers,
                                           config.max_retries):
-                _ingest(out)
+                gidx = resume_k + idx
+                _ingest(out, offsets.get(gidx))
                 if ckpt is not None:
-                    gidx = resume_k + idx
                     ckpt.save(gidx, out, offsets.get(gidx, -1))
 
-    with metrics.phase("finalize"):
+    with obs.phase("finalize"):
         if engine is not None:
             hi, lo, vals, _n = engine.finalize()
             hi = np.asarray(hi)
@@ -970,7 +1023,7 @@ def run_distinct_job(config: JobConfig) -> DistinctResult:
             regs = host_regs
         estimate = hll_estimate(regs)
 
-    with metrics.phase("write"):
+    with obs.phase("write"):
         if config.output_path:
             from map_oxidize_tpu.workloads.distinct import (
                 write_distinct_output,
@@ -984,8 +1037,9 @@ def run_distinct_job(config: JobConfig) -> DistinctResult:
     metrics.set("records_in", records_in)
     metrics.set("chunks", n_chunks)
     metrics.set("registers_filled", int(np.count_nonzero(regs)))
+    summary, trace = obs.finish(config)
     result = DistinctResult(estimate=estimate, registers=regs,
-                            metrics=metrics.summary())
+                            metrics=summary, trace=trace)
     if config.metrics:
         _log.info("metrics: %s", result.metrics)
     return result

@@ -33,6 +33,8 @@ periodically and raise rather than silently dropping keys.
 from __future__ import annotations
 
 import abc
+import contextlib
+import time
 from functools import partial
 
 import numpy as np
@@ -128,6 +130,9 @@ class StreamingEngineBase(abc.ABC):
         self._pad_val = np.asarray(_identity(self.combine, self.value_dtype))
         self._merges = 0
         self._check_every = overflow_check_every
+        #: observability bundle (obs.Obs) injected by the driver; None
+        #: keeps every record site a single attribute check
+        self.obs = None
         self.rows_fed = 0
         self._stage: list = []   # host-side staging of mapped rows
         self._staged = 0
@@ -185,12 +190,27 @@ class StreamingEngineBase(abc.ABC):
             vals = np.concatenate([s[2] for s in self._stage])
         self._stage = []
         self._staged = 0
-        for start in range(0, hi.shape[0], self.feed_batch):
-            stop = min(start + self.feed_batch, hi.shape[0])
-            self._merge_batch(self._pad(hi, lo, vals, start, stop))
-            self._merges += 1
-            if self._merges % self._check_every == 0:
-                self._check_health()
+        obs = self.obs
+        t0 = time.perf_counter() if obs is not None else 0.0
+        try:
+            # with-block, not manual enter/exit: a capacity/overflow abort
+            # from the merge must still record the span (with its error
+            # attribute) — the abort is exactly what a trace reader wants
+            with (obs.tracer.span("engine/flush", rows=int(hi.shape[0]))
+                  if obs is not None else contextlib.nullcontext()):
+                for start in range(0, hi.shape[0], self.feed_batch):
+                    stop = min(start + self.feed_batch, hi.shape[0])
+                    self._merge_batch(self._pad(hi, lo, vals, start, stop))
+                    self._merges += 1
+                    if self._merges % self._check_every == 0:
+                        self._check_health()
+        finally:
+            if obs is not None:
+                obs.registry.observe("engine/flush_ms",
+                                     (time.perf_counter() - t0) * 1e3)
+                obs.registry.count("engine/device_put_bytes",
+                                   hi.nbytes + lo.nbytes + vals.nbytes)
+                obs.registry.count("engine/flushes")
 
     # --- capacity growth (shared; subclasses provide the two hooks) -------
 
@@ -238,6 +258,11 @@ class StreamingEngineBase(abc.ABC):
                       max(next_pow2(needed), next_pow2(self.capacity + 1)))
         self._apply_grow(new_cap)
         _log.info("accumulator grown %d -> %d rows", self.capacity, new_cap)
+        if self.obs is not None:
+            self.obs.registry.count("engine/grows")
+            self.obs.registry.gauge("engine/capacity_rows", new_cap)
+            self.obs.tracer.instant("engine/grow", old=self.capacity,
+                                    new=new_cap)
         self.capacity = new_cap
 
     @abc.abstractmethod

@@ -81,6 +81,7 @@ class HostCollectReduceEngine:
         # external-memory spill state (hash-only count jobs past max_rows)
         self._staged_rows = 0
         self.peak_staged_rows = 0           # observability + test oracle
+        self.obs = None                     # obs.Obs injected by the driver
         self._spill = None                  # runtime.spill.BucketFiles
         self.spilled_rows = 0
 
@@ -140,6 +141,11 @@ class HostCollectReduceEngine:
             "host collect crossed max_rows=%d; spilling to %d disk buckets "
             "under %s", self.max_rows, 1 << self.SPILL_BUCKETS_BITS,
             self._spill.path)
+        if self.obs is not None:
+            self.obs.registry.count("spill/begin_events")
+            self.obs.tracer.instant("host_reduce/spill_begin",
+                                    max_rows=self.max_rows,
+                                    rows_fed=self.rows_fed)
         blocks, vals_list = self._keys, self._vals
         self._keys = self._vals = None
         self._staged_rows = 0
@@ -167,12 +173,17 @@ class HostCollectReduceEngine:
             k64, self.SPILL_BUCKETS_BITS)
         if vals is None:
             self._spill.write_partitioned("u64", k64[order], counts, offs)
+            spilled_bytes = int(k64.nbytes)
         else:
             rec = np.empty(k64.shape[0], self._kv_dtype())
             rec["k"] = k64[order]
             rec["v"] = vals[order]
             self._spill.write_partitioned("kv", rec, counts, offs)
+            spilled_bytes = int(rec.nbytes)
         self.spilled_rows += int(k64.shape[0])
+        if self.obs is not None:
+            self.obs.registry.count("spill/rows", int(k64.shape[0]))
+            self.obs.registry.count("spill/bytes", spilled_bytes)
 
     @staticmethod
     def _segment_bounds(keys_sorted: np.ndarray) -> np.ndarray:

@@ -1,0 +1,29 @@
+"""Version-compat shims for the jax API surface this repo rides.
+
+``jax.shard_map`` graduated from ``jax.experimental.shard_map`` (and its
+``check_rep`` knob was renamed ``check_vma``) across jax releases; the
+installed jax in a deployment may sit on either side.  Every call site in
+this repo goes through :func:`shard_map`, which dispatches to whichever
+spelling the running jax provides — so the sharded engines work from
+jax 0.4.x through current instead of AttributeError-ing on import of the
+first mesh path.
+"""
+
+from __future__ import annotations
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, **kw):
+    """``jax.shard_map`` when the running jax has it, else the
+    ``jax.experimental.shard_map`` spelling with ``check_vma`` translated
+    to its old name ``check_rep``."""
+    import jax
+
+    native = getattr(jax, "shard_map", None)
+    if native is not None:
+        return native(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as legacy
+
+    if "check_vma" in kw:
+        kw["check_rep"] = kw.pop("check_vma")
+    return legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)

@@ -1,48 +1,22 @@
-"""Phase timing and throughput metrics.
+"""Phase timing and throughput metrics — now backed by ``moxt.obs``.
 
-The reference has zero instrumentation (SURVEY.md §5: no timers, counters, or
-spans anywhere in main.rs).  Here every phase is wall-clocked, the engine
-counts records/rows, and the driver derives the BASELINE.md headline metric
-(words/sec/chip).  ``jax.profiler`` trace capture can be toggled for deep
-dives on real hardware.
+The flat 61-line ``Metrics`` dict that lived here is subsumed by
+:class:`map_oxidize_tpu.obs.metrics.MetricsRegistry` (counters, gauges,
+histograms, memory watermarks) and the span tracer in
+:mod:`map_oxidize_tpu.obs.trace`; this module keeps the old import path
+alive (``Metrics`` is the registry) plus the ``jax.profiler`` deep-dive
+toggle, which is orthogonal to the framework-level event model — it
+captures XLA's own device timeline, ours captures the host-side
+pipeline.  See docs/OBSERVABILITY.md.
 """
 
 from __future__ import annotations
 
 import contextlib
-import time
-from dataclasses import dataclass, field
 
+from map_oxidize_tpu.obs.metrics import MetricsRegistry as Metrics
 
-@dataclass
-class Metrics:
-    phases: dict[str, float] = field(default_factory=dict)
-    counters: dict[str, float] = field(default_factory=dict)
-
-    @contextlib.contextmanager
-    def phase(self, name: str):
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            self.phases[name] = self.phases.get(name, 0.0) + time.perf_counter() - t0
-
-    def count(self, name: str, delta: float = 1) -> None:
-        self.counters[name] = self.counters.get(name, 0) + delta
-
-    def set(self, name: str, value: float) -> None:
-        self.counters[name] = value
-
-    def summary(self) -> dict:
-        out = {f"time/{k}_s": round(v, 4) for k, v in self.phases.items()}
-        out.update({k: v for k, v in self.counters.items()})
-        total_records = self.counters.get("records_in")
-        map_reduce_s = sum(
-            self.phases.get(p, 0.0) for p in ("map+reduce", "finalize")
-        )
-        if total_records and map_reduce_s > 0:
-            out["records_per_sec"] = round(total_records / map_reduce_s, 1)
-        return out
+__all__ = ["Metrics", "jax_trace"]
 
 
 @contextlib.contextmanager
