@@ -214,7 +214,30 @@ def _run_size(run_job, JobConfig, corpus: str, warm: bool):
     return best[0], best[1], times
 
 
-def main() -> int:
+def parse_args(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="map_oxidize_tpu headline benchmark (see module "
+                    "docstring); sizes/runs via MOXT_BENCH_* env vars")
+    ap.add_argument("--ledger-dir", default=os.environ.get(
+        "MOXT_BENCH_LEDGER_DIR"),
+        help="append one normalized entry per benchmarked workload to "
+             "<dir>/ledger.jsonl (the obs run-ledger format)")
+    ap.add_argument("--gate", action="store_true",
+                    help="exit 3 when any workload's rate regressed beyond "
+                         "the tolerance vs its previous ledger entry "
+                         "(default ledger: .bench_cache/ledger)")
+    ap.add_argument("--gate-tolerance-pct", type=float, default=float(
+        os.environ.get("MOXT_BENCH_GATE_TOL_PCT", "10")),
+        help="regression tolerance percent for --gate (default 10)")
+    return ap.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    if args.gate and not args.ledger_dir:
+        args.ledger_dir = os.path.join(CACHE_DIR, "ledger")
     # Keep stdout/stderr quiet so the final JSON line is the only thing a
     # tail capture needs: silence jax's WARNING-level chatter (donation
     # warnings alone were a multi-KB wall in round 3) and Python warnings.
@@ -365,6 +388,22 @@ def main() -> int:
             "workloads": workloads,
         }, f, indent=1)
 
+    # --- run ledger + regression gate: every benchmarked workload appends
+    # one normalized entry (rate + vs_baseline), and --gate compares each
+    # against its PREVIOUS entry before appending — the machine-checked
+    # regression story connecting BENCH rounds
+    gate_failures: list[str] = []
+    if args.ledger_dir:
+        from map_oxidize_tpu.obs import ledger as _ledger
+
+        for entry in _bench_ledger_entries(headline, workloads):
+            if args.gate:
+                gate_failures += [
+                    f"{entry['workload']}: {r}"
+                    for r in _ledger.gate_against_previous(
+                        args.ledger_dir, entry, args.gate_tolerance_pct)]
+            _ledger.append(args.ledger_dir, entry)
+
     # compact scoreboard line: one ratio per workload, full detail on disk
     wl_ratios = {}
     for name, entry in workloads.items():
@@ -382,7 +421,45 @@ def main() -> int:
         "workloads": wl_ratios,
         "detail_file": os.path.relpath(detail_path, REPO),
     }))
+    if gate_failures:
+        # stderr so the stdout tail-capture contract (final line = the
+        # JSON scoreboard) survives a failing gate
+        for f in gate_failures:
+            print(f"GATE REGRESSION: {f}", file=sys.stderr)
+        return 3
     return 0
+
+
+def _bench_ledger_entries(headline, workloads) -> list:
+    """Normalize the bench results into obs-ledger entries: one per
+    workload under the ``bench/`` namespace, rates under the common
+    ``rate`` key the ledger's regression diff understands.  The config
+    hash is the bench harness version — sizes/workload configs are fixed
+    by the script, so same-hash entries compare apples-to-apples."""
+    import time as _time
+
+    from map_oxidize_tpu import __version__
+
+    now = round(_time.time(), 3)
+    base = {"ts_unix_s": now, "version": __version__,
+            "config_hash": "bench-harness-v1", "n_processes": 1,
+            "phases_s": {}}
+    entries = [dict(base, workload="bench/wordcount_headline",
+                    corpus_bytes=BENCH_SIZES[-1] << 20,
+                    metrics={"rate": round(headline[0], 1),
+                             "vs_baseline": round(headline[2], 3)})]
+    rate_keys = ("words_per_sec", "tokens_per_sec", "point_iters_per_sec",
+                 "median_words_per_sec")
+    for name, e in sorted(workloads.items()):
+        if not isinstance(e, dict):
+            continue
+        rate = next((e[k] for k in rate_keys if k in e), None)
+        if rate is None:
+            continue
+        entries.append(dict(
+            base, workload=f"bench/{name}",
+            metrics={"rate": rate, "vs_baseline": e.get("vs_baseline")}))
+    return entries
 
 
 def _session_probes() -> dict:

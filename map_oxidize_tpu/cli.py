@@ -6,6 +6,8 @@ directory containing ``shakes.txt``.  Usage here:
 
     python -m map_oxidize_tpu wordcount shakes.txt --top-k 10
     python -m map_oxidize_tpu bigram corpus.txt --backend tpu
+    python -m map_oxidize_tpu obs merge trace.json     # shard merge
+    python -m map_oxidize_tpu obs diff --ledger-dir runs/  # regression diff
 """
 
 from __future__ import annotations
@@ -120,6 +122,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write the structured metrics document (phase "
                         "timings, counters, gauges, histograms) here as "
                         "JSON")
+    p.add_argument("--ledger-dir", default=None,
+                   help="append this job's summary (metrics, phase times, "
+                        "config hash, version) to <dir>/ledger.jsonl — the "
+                        "history `obs diff` and `bench.py --gate` compare "
+                        "against")
+    p.add_argument("--crash-dir", default=None,
+                   help="failure flight recorder: on an abort, dump a "
+                        "post-mortem bundle (config, metrics-so-far, "
+                        "open-span-closed trace, traceback) under this "
+                        "directory before the error propagates")
     p.add_argument("--progress", action="store_true",
                    help="log periodic progress lines (rows/sec, percent "
                         "done, ETA, phase) for long streamed jobs")
@@ -157,6 +169,8 @@ def config_from_args(args: argparse.Namespace) -> JobConfig:
         trace_dir=args.trace_dir,
         trace_out=args.trace_out,
         metrics_out=args.metrics_out,
+        ledger_dir=args.ledger_dir,
+        crash_dir=args.crash_dir,
         progress=args.progress,
         progress_interval_s=args.progress_interval,
         rescan_full=args.rescan_full,
@@ -169,6 +183,14 @@ def config_from_args(args: argparse.Namespace) -> JobConfig:
 
 
 def main(argv: list[str] | None = None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "obs":
+        # artifact tools (shard merge, ledger diff): pure host-side file
+        # work — no input corpus, no jax, no backend init
+        from map_oxidize_tpu.obs.cli import obs_main
+
+        return obs_main(argv[1:])
     args = build_parser().parse_args(argv)
     configure(logging.DEBUG if args.verbose
               else logging.WARNING if args.quiet else logging.INFO)

@@ -81,6 +81,16 @@ class JobConfig:
     #: (chrome://tracing / Perfetto); "-" collects the trace onto
     #: ``result.trace`` without writing a file; None disables tracing
     trace_out: str | None = None
+    #: append every finished job's summary (metrics, phase times, config
+    #: hash, version, workload, corpus size) to ``<dir>/ledger.jsonl`` —
+    #: the regression-diff history ``obs diff`` / ``bench.py --gate``
+    #: read; None disables
+    ledger_dir: str | None = None
+    #: failure flight recorder: on an abort (conservation/overflow/
+    #: capacity/any exception) dump a post-mortem bundle (config,
+    #: metrics-so-far, open-span-closed trace, traceback) under this
+    #: directory before propagating; None disables
+    crash_dir: str | None = None
     #: emit periodic progress lines (rows/sec, percent, ETA, phase) for
     #: long streamed jobs
     progress: bool = False

@@ -24,6 +24,19 @@ replacing the ad-hoc per-driver ``Metrics()`` instantiations — so every
 layer (driver, engine, collect, shuffle, spill, checkpoint) records into
 one coherent event model.
 
+The job-level legs on top of the per-process bundle:
+
+* :mod:`~map_oxidize_tpu.obs.merge` — multi-process trace/metrics
+  shards, the merged cross-process Chrome trace (pid = process), and
+  the straggler/skew report;
+* :mod:`~map_oxidize_tpu.obs.ledger` — the append-only run ledger
+  (``--ledger-dir``) with regression diffing (``obs diff``,
+  ``bench.py --gate``) behind a version + config-hash identity check;
+* :mod:`~map_oxidize_tpu.obs.flight` — the failure flight recorder
+  (``--crash-dir``): aborts dump config/metrics/open-span-closed trace
+  before propagating, and ``Obs.recording`` is the crash-safe envelope
+  every driver wraps its body in.
+
 See ``docs/OBSERVABILITY.md`` for the event model and flag reference.
 """
 
@@ -70,11 +83,22 @@ class Obs:
     registry: MetricsRegistry
     tracer: Tracer
     heartbeat: Heartbeat | None = None
+    #: this process's slot and the job's process count (multi-process
+    #: runs; 0/1 for the single-controller drivers)
+    process: int = 0
+    n_processes: int = 1
 
     @classmethod
-    def from_config(cls, config) -> "Obs":
+    def from_config(cls, config, process: int = 0,
+                    n_processes: int = 1) -> "Obs":
         """Build the bundle a job's config asks for.  ``trace_out='-'``
-        collects the trace for ``result.trace`` without writing a file."""
+        collects the trace for ``result.trace`` without writing a file.
+
+        Multi-process jobs pass their slot: heartbeat lines are prefixed
+        with the process id and emitted from process 0 only (every
+        process advances in lockstep, so P copies of the same line are
+        noise; ``MOXT_PROGRESS_ALL_PROCS=1`` un-silences the rest for
+        per-process debugging)."""
         tracer = Tracer(enabled=bool(config.trace_out))
         hb = None
         if getattr(config, "progress", False):
@@ -83,9 +107,24 @@ class Obs:
                 total = os.path.getsize(config.input_path)
             except OSError:
                 pass
-            hb = Heartbeat(total_bytes=total,
-                           interval_s=config.progress_interval_s)
-        return cls(registry=MetricsRegistry(), tracer=tracer, heartbeat=hb)
+            emit = None
+            wanted = True
+            if n_processes > 1:
+                if process != 0 and not os.environ.get(
+                        "MOXT_PROGRESS_ALL_PROCS"):
+                    wanted = False
+                else:
+                    from map_oxidize_tpu.utils.logging import get_logger
+
+                    plog = get_logger(__name__)
+                    emit = (lambda line, _p=process:
+                            plog.info("[proc %d] %s", _p, line))
+            if wanted:
+                hb = Heartbeat(total_bytes=total,
+                               interval_s=config.progress_interval_s,
+                               emit=emit)
+        return cls(registry=MetricsRegistry(), tracer=tracer, heartbeat=hb,
+                   process=process, n_processes=n_processes)
 
     @contextlib.contextmanager
     def phase(self, name: str, **attrs):
@@ -107,22 +146,69 @@ class Obs:
         site every driver instruments)."""
         return self.tracer.span("engine/feed_block", **attrs)
 
-    def finish(self, config) -> tuple[dict, list | None]:
+    def stamp(self, config, workload: str | None = None) -> dict:
+        """Provenance stamp carried by every exported document (metrics,
+        trace, shard, ledger entry, crash bundle): the package version
+        plus the identity config hash — what ``obs diff``/``obs merge``
+        check before comparing or combining — and the process slot."""
+        from map_oxidize_tpu import __version__
+        from map_oxidize_tpu.obs.ledger import config_hash
+
+        return {
+            "version": __version__,
+            "config_hash": config_hash(config),
+            "workload": workload,
+            "process": self.process,
+            "n_processes": self.n_processes,
+            "wall_start_unix_s": round(self.tracer.wall_start, 6),
+        }
+
+    def finish(self, config, workload: str | None = None
+               ) -> tuple[dict, list | None]:
         """End-of-job hook: final memory watermarks, flag-driven file
-        exports, and the ``(summary, trace_events)`` pair the result
+        exports (version/config-hash stamped), the optional ledger
+        append, and the ``(summary, trace_events)`` pair the result
         carries.  ``trace_events`` is None when tracing was off."""
         sample_host_memory(self.registry)
         sample_device_memory(self.registry)
         if self.heartbeat is not None:
             self.heartbeat.final_beat()
+        meta = self.stamp(config, workload)
         if config.metrics_out:
-            write_json_atomic(config.metrics_out, self.registry.to_dict())
+            write_json_atomic(config.metrics_out,
+                              dict(self.registry.to_dict(), meta=meta))
         trace = self.tracer.chrome_trace() if self.tracer.enabled else None
-        if trace is not None and config.trace_out != "-":
-            # dump the list just built — rebuilding it via write_chrome
-            # would pay the tid-compaction/scalarize pass twice
-            write_json_atomic(config.trace_out, trace, indent=None)
-        return self.registry.summary(), trace
+        if trace is not None:
+            trace.insert(0, {"name": "moxt_meta", "ph": "M",
+                             "pid": self.tracer._pid, "tid": 0,
+                             "args": meta})
+            if config.trace_out != "-":
+                # dump the list just built — rebuilding it via
+                # write_chrome would pay the tid-compaction pass twice
+                write_json_atomic(config.trace_out, trace, indent=None)
+        summary = self.registry.summary()
+        if getattr(config, "ledger_dir", None):
+            from map_oxidize_tpu.obs import ledger
+
+            ledger.append(config.ledger_dir, ledger.build_entry(
+                config, workload or "?", summary,
+                n_processes=self.n_processes))
+        return summary, trace
+
+    @contextlib.contextmanager
+    def recording(self, config, workload: str | None = None):
+        """Crash-safe envelope for a job body: on ANY exception the
+        flight recorder closes open spans, flushes the partial metrics/
+        trace to their configured paths, and dumps a post-mortem bundle
+        under ``config.crash_dir`` — then the exception propagates
+        unchanged.  Zero cost on the success path."""
+        try:
+            yield self
+        except BaseException as exc:
+            from map_oxidize_tpu.obs import flight
+
+            flight.record_failure(self, config, exc, workload=workload)
+            raise
 
 
 def write_json_atomic(path: str, payload, indent: int | None = 1) -> None:

@@ -1,0 +1,127 @@
+"""``python -m map_oxidize_tpu obs ...`` — observability artifact tools.
+
+Two subcommands, both pure host-side file work (no jax, no backend
+init):
+
+* ``obs merge`` — combine a distributed run's per-process trace shards
+  (``<trace_out>.proc<i>``) into one Chrome trace (pid = process slot)
+  plus a skew/straggler report.  Process 0 does this automatically at
+  job end when the shards share a filesystem; this command covers the
+  copied-from-isolated-hosts case and re-merges.
+* ``obs diff`` — compare two entries of a run ledger
+  (``--ledger-dir``'s ``ledger.jsonl``): per-phase and per-counter
+  deltas, identity-checked (workload, config hash, version) so
+  apples-to-oranges comparisons refuse by default; ``--gate`` exits
+  nonzero when a regression exceeds the threshold.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def build_obs_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="map_oxidize_tpu obs",
+        description="observability artifact tools (merge shards, diff "
+                    "ledger runs)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    m = sub.add_parser(
+        "merge", help="merge per-process trace shards into one Chrome "
+                      "trace + skew report")
+    m.add_argument("base", help="the run's --trace-out path: shards are "
+                                "<base>.proc<i>, the merged trace is "
+                                "written to <base> (or --out)")
+    m.add_argument("--out", default=None,
+                   help="merged Chrome trace path (default: the base path)")
+    m.add_argument("--skew-out", default=None,
+                   help="skew report path (default: <out>.skew.json)")
+
+    d = sub.add_parser(
+        "diff", help="diff two ledger entries (per-phase/per-counter "
+                     "deltas; --gate for a nonzero regression exit)")
+    d.add_argument("--ledger-dir", required=True,
+                   help="directory holding ledger.jsonl")
+    d.add_argument("runs", nargs="*", default=[],
+                   help="two entry indices into the (filtered) ledger, "
+                        "python-style (default: -2 -1 — previous vs last)")
+    d.add_argument("--workload", default=None,
+                   help="filter the ledger to one workload first")
+    d.add_argument("--threshold-pct", type=float, default=10.0,
+                   help="regression threshold: a phase slower / throughput "
+                        "lower by more than this percent flags (default 10)")
+    d.add_argument("--gate", action="store_true",
+                   help="exit 3 when any regression exceeds the threshold")
+    d.add_argument("--force", action="store_true",
+                   help="diff even when workload/config-hash/version "
+                        "differ (mismatches print as warnings)")
+    return p
+
+
+def obs_main(argv: list[str]) -> int:
+    args = build_obs_parser().parse_args(argv)
+    if args.cmd == "merge":
+        return _merge(args)
+    return _diff(args)
+
+
+def _merge(args) -> int:
+    from map_oxidize_tpu.obs.merge import find_shards, merge_to_files
+
+    shards = find_shards(args.base)
+    if not shards:
+        print(f"error: no shards found at {args.base}.proc*",
+              file=sys.stderr)
+        return 2
+    out = args.out if args.out else args.base
+    try:
+        skew = merge_to_files(shards, out, args.skew_out)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    skew_path = args.skew_out if args.skew_out else out + ".skew.json"
+    print(f"merged {len(shards)} shards -> {out}")
+    print(f"skew report -> {skew_path}")
+    for r in skew["straggler_ranking"]:
+        print(f"  proc {r['process']}: work {r['work_s']:.3f}s, "
+              f"collective wait {r['collective_wait_s']:.3f}s")
+    return 0
+
+
+def _diff(args) -> int:
+    from map_oxidize_tpu.obs import ledger
+
+    entries = ledger.read(args.ledger_dir, args.workload)
+    if not entries:
+        print(f"error: no ledger entries under {args.ledger_dir}"
+              + (f" for workload {args.workload!r}" if args.workload
+                 else ""), file=sys.stderr)
+        return 2
+    specs = args.runs if args.runs else ["-2", "-1"]
+    if len(specs) != 2:
+        print("error: diff takes exactly two entry indices",
+              file=sys.stderr)
+        return 2
+    try:
+        idx = [int(s) for s in specs]
+    except ValueError:
+        print(f"error: run specs must be integer indices, got {specs}",
+              file=sys.stderr)
+        return 2
+    try:
+        a, b = entries[idx[0]], entries[idx[1]]
+    except IndexError:
+        print(f"error: ledger has {len(entries)} entries; indices {idx} "
+              "out of range", file=sys.stderr)
+        return 2
+    try:
+        diff = ledger.diff_entries(a, b, args.threshold_pct, args.force)
+    except ledger.LedgerMismatch as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    print(ledger.format_diff(a, b, diff))
+    if args.gate and diff["regressions"]:
+        return 3
+    return 0

@@ -1,0 +1,112 @@
+"""Failure flight recorder: post-mortem evidence for aborted jobs.
+
+The driver's invariant checks abort loudly by design — count
+conservation, shuffle overflow, capacity, duplicate live keys — but
+until this module an abort left *nothing*: ``Obs.finish`` only ran on
+success, so a failed 10GB run discarded its spans, counters, and phase
+clocks along with the answer.  :func:`record_failure` is the except-path
+twin of ``finish``: it closes still-open spans (the trace stays
+well-formed), snapshots memory watermarks, and dumps one bundle per
+crash under ``--crash-dir``:
+
+* ``error.json``    — exception type/message/traceback, run metadata
+  (version, config hash, workload, process slot), full config;
+* ``metrics.json``  — the metrics document as of the crash;
+* ``trace.json``    — Chrome trace-event JSON with the interrupted spans
+  closed at crash time and tagged ``unfinished`` (only when the run
+  traced).
+
+It also flushes the partial trace/metrics to the ``--trace-out`` /
+``--metrics-out`` paths the run asked for — those flags are a promise of
+artifacts, and a crash is when they matter most.  Every step is
+best-effort: a recorder error must never mask the original exception.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+import traceback
+
+from map_oxidize_tpu.utils.logging import get_logger
+
+_log = get_logger(__name__)
+
+
+def crash_bundle_dir(crash_dir: str, process: int = 0) -> str:
+    """``<crash_dir>/crash_<utc>_p<proc>_<pid>`` — collision-proof when
+    several processes of one job crash into a shared directory."""
+    stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime())
+    return os.path.join(crash_dir,
+                        f"crash_{stamp}_p{process}_{os.getpid()}")
+
+
+def record_failure(obs, config, exc: BaseException,
+                   workload: str | None = None) -> str | None:
+    """Dump the post-mortem bundle; returns its directory (None when no
+    ``crash_dir`` is configured and no partial artifacts were asked
+    for).  Never raises."""
+    try:
+        return _record(obs, config, exc, workload)
+    except Exception as rec_err:  # pragma: no cover - defensive
+        _log.warning("flight recorder failed (%s); original error "
+                     "propagates", rec_err)
+        return None
+
+
+def _record(obs, config, exc, workload):
+    from map_oxidize_tpu.obs import write_json_atomic
+    from map_oxidize_tpu.obs.ledger import config_hash
+    from map_oxidize_tpu.obs.metrics import (
+        sample_device_memory,
+        sample_host_memory,
+    )
+
+    err = f"{type(exc).__name__}: {exc}"
+    obs.tracer.close_open_spans(error=err)
+    sample_host_memory(obs.registry)
+    sample_device_memory(obs.registry)
+    obs.registry.set("aborted", True)
+
+    meta = obs.stamp(config, workload)
+    metrics_doc = dict(obs.registry.to_dict(), meta=meta)
+    trace = obs.tracer.chrome_trace() if obs.tracer.enabled else None
+    if trace is not None:
+        trace.insert(0, {"name": "moxt_meta", "ph": "M",
+                         "pid": obs.tracer._pid, "tid": 0,
+                         "args": dict(meta, aborted=True)})
+
+    # honor the run's own artifact flags with the partial documents
+    if config.metrics_out:
+        path = (config.metrics_out if obs.n_processes <= 1
+                else f"{config.metrics_out}.proc{obs.process}")
+        write_json_atomic(path, metrics_doc)
+    if trace is not None and config.trace_out and config.trace_out != "-":
+        path = (config.trace_out if obs.n_processes <= 1
+                else f"{config.trace_out}.proc{obs.process}")
+        if obs.n_processes > 1:
+            from map_oxidize_tpu.obs.merge import write_shard
+
+            write_shard(path, meta, trace, metrics_doc)
+        else:
+            write_json_atomic(path, trace, indent=None)
+
+    if not getattr(config, "crash_dir", None):
+        return None
+    bundle = crash_bundle_dir(config.crash_dir, obs.process)
+    os.makedirs(bundle, exist_ok=True)
+    write_json_atomic(os.path.join(bundle, "error.json"), {
+        "error": err,
+        "traceback": "".join(traceback.format_exception(
+            type(exc), exc, exc.__traceback__)),
+        "meta": meta,
+        "config": dataclasses.asdict(config),
+        "config_hash": config_hash(config),
+    })
+    write_json_atomic(os.path.join(bundle, "metrics.json"), metrics_doc)
+    if trace is not None:
+        write_json_atomic(os.path.join(bundle, "trace.json"), trace,
+                          indent=None)
+    _log.error("job aborted (%s); flight-recorder bundle: %s", err, bundle)
+    return bundle

@@ -1,0 +1,260 @@
+"""Run ledger: an append-only JSONL history of finished jobs, with
+regression diffing.
+
+Five BENCH rounds exist as loose ``BENCH_r*.json`` artifacts with no
+machine-checked story connecting them; the ledger is that story's spine.
+Every finished job (``--ledger-dir``) appends one line — workload, corpus
+size, package version, a config hash, phase wall-clocks, and the full
+flat metrics summary — and two entries of the same workload can then be
+diffed (``python -m map_oxidize_tpu obs diff``) or gated
+(``bench.py --gate``): per-phase and per-counter deltas against a
+threshold, nonzero exit on regression.
+
+The config hash covers the fields that change what the engines compute
+or how (shards, batch sizes, capacities, tokenizer, precision...) and
+excludes pure I/O plumbing (output paths, observability flags), so two
+runs of the same workload on the same corpus compare apples-to-apples
+even when their artifact paths differ.  ``diff`` refuses mismatched
+workloads or config hashes unless forced.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import time
+
+#: config fields that do NOT change what a run computes or how fast —
+#: artifact paths, observability plumbing, and per-process addressing.
+#: ``dist_process_id``/``dist_coordinator`` are a process's slot and a
+#: rendezvous address, identical-job facts that differ per participant —
+#: with them in the hash, shard merging would refuse every CLI-launched
+#: multi-process run; ``dist_num_processes`` stays identity (process
+#: count changes the collective topology and the perf envelope).
+_NON_IDENTITY_FIELDS = frozenset({
+    "input_path", "output_path", "checkpoint_dir", "keep_intermediates",
+    "trace_dir", "trace_out", "metrics_out", "metrics", "progress",
+    "progress_interval_s", "ledger_dir", "crash_dir",
+    "dist_coordinator", "dist_process_id",
+})
+
+LEDGER_FILE = "ledger.jsonl"
+
+
+def config_identity(config) -> dict:
+    """The identity-relevant config fields, as a JSON-stable dict."""
+    d = dataclasses.asdict(config)
+    return {k: v for k, v in sorted(d.items())
+            if k not in _NON_IDENTITY_FIELDS}
+
+
+def config_hash(config) -> str:
+    """16-hex digest of the identity-relevant config fields."""
+    blob = json.dumps(config_identity(config), sort_keys=True)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def build_entry(config, workload: str, summary: dict,
+                n_processes: int = 1, extra: dict | None = None) -> dict:
+    """One ledger line for a finished job.  ``summary`` is the flat
+    registry summary (``time/<phase>_s`` keys, counters/gauges by name);
+    it is stored whole so diffs can reach any counter, with the phase
+    times also lifted out for the common case."""
+    from map_oxidize_tpu import __version__
+
+    corpus_bytes = None
+    try:
+        corpus_bytes = os.path.getsize(config.input_path)
+    except (OSError, TypeError):
+        pass
+    entry = {
+        "ts_unix_s": round(time.time(), 3),
+        "version": __version__,
+        "config_hash": config_hash(config),
+        "workload": workload,
+        "corpus_bytes": corpus_bytes,
+        "n_processes": n_processes,
+        "phases_s": {k[len("time/"):-len("_s")]: v
+                     for k, v in summary.items()
+                     if k.startswith("time/") and k.endswith("_s")},
+        "metrics": _jsonable(summary),
+    }
+    if extra:
+        entry.update(extra)
+    return entry
+
+
+def append(ledger_dir: str, entry: dict) -> str:
+    """Append one entry to ``<ledger_dir>/ledger.jsonl``.  O_APPEND with a
+    single write: concurrent appenders (multi-process jobs, parallel
+    benches) interleave whole lines, never split one."""
+    os.makedirs(ledger_dir, exist_ok=True)
+    path = os.path.join(ledger_dir, LEDGER_FILE)
+    line = json.dumps(entry, sort_keys=True) + "\n"
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    try:
+        os.write(fd, line.encode())
+    finally:
+        os.close(fd)
+    return path
+
+
+def read(ledger_dir: str, workload: str | None = None) -> list[dict]:
+    """All entries, oldest first, optionally filtered by workload.
+    Corrupt lines (a crashed appender's torn tail) are skipped, not
+    fatal — the ledger is evidence, losing one line must not lose all."""
+    path = os.path.join(ledger_dir, LEDGER_FILE)
+    entries = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    e = json.loads(line)
+                except ValueError:
+                    continue
+                if workload is None or e.get("workload") == workload:
+                    entries.append(e)
+    except OSError:
+        pass
+    return entries
+
+
+# --- diffing ---------------------------------------------------------------
+
+
+class LedgerMismatch(ValueError):
+    """Two entries are not comparable (different workload, config hash,
+    or package version) — apples-to-oranges unless the caller forces."""
+
+
+def check_comparable(a: dict, b: dict, force: bool = False) -> list[str]:
+    """Raise :class:`LedgerMismatch` on identity mismatches (or return
+    them as warnings when ``force``).  ``corpus_bytes`` is identity too:
+    the config hash deliberately excludes input paths (tmp dirs differ
+    between logically-identical runs), so the corpus SIZE is what stops
+    a 64MB run gating a 10GB run's phase times."""
+    problems = []
+    for key in ("workload", "config_hash", "version", "corpus_bytes"):
+        if a.get(key) != b.get(key):
+            problems.append(
+                f"{key} differs: {a.get(key)!r} vs {b.get(key)!r}")
+    if problems and not force:
+        raise LedgerMismatch(
+            "entries are not comparable (" + "; ".join(problems)
+            + "); pass --force to diff anyway")
+    return problems
+
+
+def diff_entries(a: dict, b: dict, threshold_pct: float = 10.0,
+                 force: bool = False) -> dict:
+    """Per-phase / per-counter deltas from entry ``a`` (before) to ``b``
+    (after).  Returns ``{"rows": [...], "regressions": [...],
+    "warnings": [...]}`` where each row is ``(name, before, after,
+    delta_pct)`` and a regression is a phase that slowed — or a
+    throughput that dropped — beyond ``threshold_pct`` (with a 50 ms
+    absolute floor on phase noise)."""
+    warnings = check_comparable(a, b, force)
+    rows: list[tuple] = []
+    regressions: list[str] = []
+
+    pa, pb = a.get("phases_s", {}), b.get("phases_s", {})
+    for name in sorted(set(pa) | set(pb)):
+        va, vb = pa.get(name), pb.get(name)
+        pct = _delta_pct(va, vb)
+        rows.append((f"phase/{name}_s", va, vb, pct))
+        if (pct is not None and pct > threshold_pct
+                and vb is not None and va is not None
+                and vb - va > 0.05):
+            regressions.append(
+                f"phase {name}: {va:.3f}s -> {vb:.3f}s (+{pct:.1f}%)")
+
+    ma, mb = a.get("metrics", {}), b.get("metrics", {})
+    skip = {k for k in set(ma) | set(mb)
+            if k.startswith(("time/", "mem/")) or "_ms/" in k
+            or k.endswith(("_s", "_ms"))}
+    for name in sorted((set(ma) | set(mb)) - skip):
+        va, vb = ma.get(name), mb.get(name)
+        if not (isinstance(va, (int, float)) or isinstance(vb, (int, float))):
+            continue
+        pct = _delta_pct(va, vb)
+        if name in ("records_per_sec", "rate"):
+            rows.append((name, va, vb, pct))
+            if pct is not None and pct < -threshold_pct:
+                regressions.append(
+                    f"{name}: {va:,.1f} -> {vb:,.1f} ({pct:.1f}%)")
+        elif va != vb:
+            rows.append((name, va, vb, pct))
+    return {"rows": rows, "regressions": regressions, "warnings": warnings}
+
+
+def format_diff(a: dict, b: dict, diff: dict) -> str:
+    """Human-readable diff report (the ``obs diff`` stdout)."""
+    out = [
+        f"ledger diff: {a.get('workload')} "
+        f"@{_fmt_ts(a.get('ts_unix_s'))} -> @{_fmt_ts(b.get('ts_unix_s'))}"
+        f"  (v{a.get('version')}, cfg {a.get('config_hash')})",
+    ]
+    out += [f"  WARNING: {w}" for w in diff["warnings"]]
+    for name, va, vb, pct in diff["rows"]:
+        ps = "" if pct is None else f"  {pct:+.1f}%"
+        out.append(f"  {name}: {_fmt_v(va)} -> {_fmt_v(vb)}{ps}")
+    if diff["regressions"]:
+        out.append("regressions beyond threshold:")
+        out += [f"  !! {r}" for r in diff["regressions"]]
+    else:
+        out.append("no regressions beyond threshold")
+    return "\n".join(out)
+
+
+def gate_against_previous(ledger_dir: str, entry: dict,
+                          threshold_pct: float = 10.0) -> list[str]:
+    """The ``bench.py --gate`` primitive: compare ``entry`` against the
+    most recent comparable ledger entry (same workload + config hash;
+    versions may differ — catching the regression a version bump shipped
+    is the point).  Returns regression strings (empty = pass, or no
+    prior comparable entry to gate against)."""
+    prior = [e for e in read(ledger_dir, entry.get("workload"))
+             if e.get("config_hash") == entry.get("config_hash")
+             and e.get("corpus_bytes") == entry.get("corpus_bytes")
+             and e.get("ts_unix_s") != entry.get("ts_unix_s")]
+    if not prior:
+        return []
+    diff = diff_entries(prior[-1], entry, threshold_pct, force=True)
+    return diff["regressions"]
+
+
+def _delta_pct(va, vb):
+    if not isinstance(va, (int, float)) or not isinstance(vb, (int, float)):
+        return None
+    if va == 0:
+        return None
+    return 100.0 * (vb - va) / va
+
+
+def _fmt_v(v):
+    if isinstance(v, float):
+        return f"{v:,.4g}"
+    if isinstance(v, int):
+        return f"{v:,}"
+    return "-" if v is None else str(v)
+
+
+def _fmt_ts(ts):
+    if not isinstance(ts, (int, float)):
+        return "?"
+    return time.strftime("%Y-%m-%dT%H:%M:%S", time.localtime(ts))
+
+
+def _jsonable(d: dict) -> dict:
+    out = {}
+    for k, v in d.items():
+        item = getattr(v, "item", None)
+        if item is not None and getattr(v, "ndim", 0) == 0:
+            v = item()
+        out[k] = v
+    return out

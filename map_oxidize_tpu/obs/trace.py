@@ -50,12 +50,13 @@ class Span:
     is annotated on the event (``error`` attribute) rather than losing
     the span."""
 
-    __slots__ = ("_tracer", "name", "attrs", "_t0", "_depth")
+    __slots__ = ("_tracer", "name", "attrs", "_t0", "_depth", "_done")
 
     def __init__(self, tracer: "Tracer", name: str, attrs: dict):
         self._tracer = tracer
         self.name = name
         self.attrs = attrs
+        self._done = False
 
     def set(self, **attrs) -> "Span":
         """Attach/overwrite attributes while the span is open."""
@@ -78,6 +79,11 @@ class Span:
             stack.pop()
         if stack:
             stack.pop()
+        if self._done:
+            # close_open_spans already exported this span (a crash on
+            # another thread force-closed it); don't record it twice
+            return False
+        self._done = True
         if exc_type is not None:
             self.attrs["error"] = f"{exc_type.__name__}: {exc}"
         self._tracer._record(self.name, self._t0, t1, self._depth,
@@ -98,9 +104,16 @@ class Tracer:
         self.enabled = enabled
         self._clock = clock
         self._epoch = clock()
+        #: wall-clock instant of the epoch — the cross-process alignment
+        #: anchor (perf_counter epochs are per-process and incomparable;
+        #: the shard merger offsets each shard by its wall start)
+        self.wall_start = time.time()
         self._events: list[dict] = []
         self._lock = threading.Lock()
         self._local = threading.local()
+        #: every thread's open-span stack, for close_open_spans (the
+        #: thread-local view alone can only see the CURRENT thread's)
+        self._stacks: list[list] = []
         self._pid = os.getpid()
 
     # --- recording --------------------------------------------------------
@@ -109,7 +122,40 @@ class Tracer:
         stack = getattr(self._local, "stack", None)
         if stack is None:
             stack = self._local.stack = []
+            with self._lock:
+                # remember the owning thread: close_open_spans runs on
+                # the CRASHING thread but must attribute each leaked
+                # span to the thread that opened it
+                self._stacks.append((threading.get_ident(), stack))
         return stack
+
+    def close_open_spans(self, error: str | None = None) -> int:
+        """Record every still-open span (any thread) as ended NOW, tagged
+        ``unfinished`` (plus ``error`` when given), under its OWNING
+        thread's tid.  The flight recorder calls this when a job dies
+        mid-phase so the exported trace is well-formed — Perfetto renders
+        a truncated timeline instead of losing the phases the crash
+        interrupted.  Spans closed here are marked done, so a thread
+        that later unwinds its ``with`` block does not record a
+        duplicate."""
+        if not self.enabled:
+            return 0
+        now = self._clock()
+        with self._lock:
+            stacks = [(tid, list(s)) for tid, s in self._stacks]
+            for _tid, s in self._stacks:
+                s.clear()
+        closed = 0
+        for tid, stack in stacks:
+            for depth, span in enumerate(stack):
+                span._done = True
+                attrs = dict(span.attrs, unfinished=True)
+                if error is not None:
+                    attrs.setdefault("error", error)
+                self._record(span.name, span._t0, now, depth, attrs,
+                             tid=tid)
+                closed += 1
+        return closed
 
     def span(self, name: str, **attrs):
         """Open a named span (context manager).  Returns the shared no-op
@@ -134,13 +180,13 @@ class Tracer:
             })
 
     def _record(self, name: str, t0: float, t1: float, depth: int,
-                attrs: dict) -> None:
+                attrs: dict, tid: int | None = None) -> None:
         with self._lock:
             self._events.append({
                 "name": name, "ph": "X",
                 "ts": (t0 - self._epoch) * 1e6,
                 "dur": (t1 - t0) * 1e6,
-                "tid": threading.get_ident(),
+                "tid": threading.get_ident() if tid is None else tid,
                 "depth": depth,
                 "args": attrs,
             })

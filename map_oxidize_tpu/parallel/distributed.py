@@ -48,6 +48,7 @@ import numpy as np
 
 from map_oxidize_tpu.api import MapOutput, SumReducer
 from map_oxidize_tpu.config import JobConfig
+from map_oxidize_tpu.obs import Obs
 from map_oxidize_tpu.ops.hashing import SENTINEL
 from map_oxidize_tpu.parallel.collect import (
     ShardedCollectEngine as ShardedCollectEngineBase,
@@ -120,6 +121,17 @@ class DistributedReduceEngine:
         # lockstep continue-flag: a [S] ones/zeros vector summed over the
         # mesh — every process must call this the same number of times
         self._flag_sum = _make_flag_sum(self.mesh)
+
+    # --- observability: the wrapped engine records the flush spans and
+    # shuffle counters, so the bundle is handed straight through to it
+
+    @property
+    def obs(self):
+        return self._eng.obs
+
+    @obs.setter
+    def obs(self, value) -> None:
+        self._eng.obs = value
 
     # --- replicated host syncs -------------------------------------------
 
@@ -428,6 +440,8 @@ class DistributedResult:
     flag_s: float = 0.0               # ... and their total wall-clock
     resumed_chunks: int = 0           # chunks replayed from checkpoint
     metrics: "dict | None" = None     # THIS process's registry summary
+    trace: "list | None" = None       # THIS process's Chrome events
+    #                                   (None when tracing was off)
 
 
 def _local_chunks(config: JobConfig, proc: int, n_proc: int, doc_mode: bool,
@@ -469,7 +483,29 @@ def run_distributed_job(config: JobConfig, workload: str
     ``invertedindex`` (collect engine), ``distinct`` (local HLL registers,
     one max-merge allgather).  With ``config.checkpoint_dir``, each
     process spills its mapped chunks under ``<dir>/proc_<id>`` (identity
-    includes the process count and id) and resumes its own prefix."""
+    includes the process count and id) and resumes its own prefix.
+
+    Observability runs the full per-process bundle (spans + counters +
+    heartbeat, not just counters): each process writes a trace/metrics
+    shard (``<path>.proc<i>``), process 0 merges the shards into one
+    Chrome trace + skew report at job end when they share a filesystem
+    (:mod:`map_oxidize_tpu.obs.merge`), and any abort passes through the
+    flight recorder (``config.crash_dir``) before propagating."""
+    import jax
+
+    config.validate()
+    obs = Obs.from_config(config, process=jax.process_index(),
+                          n_processes=jax.process_count())
+    with obs.recording(config, workload):
+        if workload == "distinct":
+            return _run_distributed_distinct(config, obs)
+        if workload == "kmeans":
+            return _run_distributed_kmeans(config, obs)
+        return _run_distributed_core(config, workload, obs)
+
+
+def _run_distributed_core(config: JobConfig, workload: str, obs: Obs
+                          ) -> DistributedResult:
     import time as _time
 
     from map_oxidize_tpu.ops.hashing import HashDictionary, join_u64
@@ -477,23 +513,7 @@ def run_distributed_job(config: JobConfig, workload: str
     from map_oxidize_tpu.workloads.bigram import make_bigram
     from map_oxidize_tpu.workloads.wordcount import make_wordcount
 
-    config.validate()
-    if config.trace_out or config.progress:
-        # say so rather than silently dropping the flags: span tracing and
-        # the heartbeat are single-process features for now
-        _log.warning("--trace-out/--progress are not wired for "
-                     "multi-process jobs; distributed runs record "
-                     "counters only (--metrics-out)")
-    if workload in ("distinct", "kmeans"):
-        if config.metrics_out:
-            _log.warning("--metrics-out is not yet wired for distributed "
-                         "%s; no metrics file will be written", workload)
-        if workload == "distinct":
-            return _run_distributed_distinct(config)
-        return _run_distributed_kmeans(config)
-    from map_oxidize_tpu.obs import MetricsRegistry
-
-    registry = MetricsRegistry()
+    registry = obs.registry
     use_native = resolve_mapper(config, workload) == "native"
     doc_mode = workload == "invertedindex"
     if workload == "wordcount":
@@ -513,6 +533,7 @@ def run_distributed_job(config: JobConfig, workload: str
         engine = DistributedCollectEngine(config, **collect_engine_kw(config))
     else:
         raise ValueError(f"unknown distributed workload {workload!r}")
+    engine.obs = obs
     P_ = engine.n_proc
     dictionary = HashDictionary()
 
@@ -534,7 +555,8 @@ def run_distributed_job(config: JobConfig, workload: str
             CheckpointStore.job_meta(config, workload, extra={
                 "dist_processes": P_,
                 "dist_process_id": engine.proc,
-            }))
+            }),
+            registry=registry)
     vals_dtype = np.uint32 if doc_mode else np.int32
 
     def _produce():
@@ -558,14 +580,21 @@ def run_distributed_job(config: JobConfig, workload: str
         save_at = replayed
         for _idx, chunk, base in _local_chunks(config, engine.proc, P_,
                                                doc_mode, replayed):
-            if doc_mode:
-                out = mapper.map_docs(chunk, base)
-            else:
-                out = mapper.map_chunk(bytes(chunk))
-            out.ensure_planes()  # no-op except for compact keys64 outputs
+            with obs.tracer.span("dist/map_chunk", index=_idx,
+                                 bytes=len(chunk)):
+                if doc_mode:
+                    out = mapper.map_docs(chunk, base)
+                else:
+                    out = mapper.map_chunk(bytes(chunk))
+                out.ensure_planes()  # no-op except compact keys64 outputs
             if ckpt is not None:
                 ckpt.save(save_at, out, base + len(chunk))
                 save_at += 1
+            if obs.heartbeat is not None:
+                # processes advance in lockstep, so this process's chunk
+                # end offset tracks GLOBAL progress through the file
+                obs.heartbeat.update(rows=out.records_in,
+                                     bytes_done=base + len(chunk))
             yield out
 
     source = _produce()
@@ -591,28 +620,34 @@ def run_distributed_job(config: JobConfig, workload: str
     exhausted = False
     flag_rounds = 0
     flag_s = 0.0
-    while True:
-        while not exhausted and staged < engine.local_rows:
-            try:
-                out = next(source)
-            except StopIteration:
-                exhausted = True
+    with obs.phase("map+reduce"):
+        while True:
+            while not exhausted and staged < engine.local_rows:
+                try:
+                    out = next(source)
+                except StopIteration:
+                    exhausted = True
+                    break
+                dictionary.update(out.dictionary)
+                staged_outs.append(out)
+                staged += len(out)
+                records += out.records_in
+            have = staged > 0
+            t0 = _time.perf_counter()
+            with obs.tracer.span("dist/lockstep_flag"):
+                cont = engine.any_remaining(have)
+            flag_s += _time.perf_counter() - t0
+            flag_rounds += 1
+            if not cont:
                 break
-            dictionary.update(out.dictionary)
-            staged_outs.append(out)
-            staged += len(out)
-            records += out.records_in
-        have = staged > 0
-        t0 = _time.perf_counter()
-        cont = engine.any_remaining(have)
-        flag_s += _time.perf_counter() - t0
-        flag_rounds += 1
-        if not cont:
-            break
-        engine.merge_local(*_pop_block())
+            blk = _pop_block()
+            with obs.tracer.span("dist/merge_local",
+                                 rows=int(blk[0].shape[0])):
+                engine.merge_local(*blk)
 
     if doc_mode:
-        keys, docs = engine.finalize()
+        with obs.phase("finalize"):
+            keys, docs = engine.finalize()
         # per-term doc counts from the sorted runs (term segments are
         # disjoint across shards, so run lengths are global df)
         if keys.shape[0]:
@@ -636,16 +671,18 @@ def run_distributed_job(config: JobConfig, workload: str
             # (ADVICE r5 — the blowup the CSR design exists to avoid)
             from map_oxidize_tpu.io.writer import write_postings_stream
 
-            names = partition_strings(uniq.tolist(), dictionary,
-                                      engine.proc, P_)
-            ends = np.append(bounds, keys.shape[0])
-            owned = sorted(
-                (names[int(h)], j) for j, h in enumerate(uniq.tolist())
-                if int(h) % P_ == engine.proc)  # term-byte output order
-            n_terms, n_bytes = write_postings_stream(
-                partition_output_path(config.output_path, engine.proc, P_),
-                ((term, np.sort(docs[ends[j]:ends[j + 1]]))
-                 for term, j in owned))
+            with obs.phase("write"):
+                names = partition_strings(uniq.tolist(), dictionary,
+                                          engine.proc, P_)
+                ends = np.append(bounds, keys.shape[0])
+                owned = sorted(
+                    (names[int(h)], j) for j, h in enumerate(uniq.tolist())
+                    if int(h) % P_ == engine.proc)  # term-byte output order
+                n_terms, n_bytes = write_postings_stream(
+                    partition_output_path(config.output_path, engine.proc,
+                                          P_),
+                    ((term, np.sort(docs[ends[j]:ends[j + 1]]))
+                     for term, j in owned))
             registry.count("dist/partition_terms_written", n_terms)
             registry.count("dist/partition_bytes_written", n_bytes)
         result = DistributedResult(
@@ -654,7 +691,8 @@ def run_distributed_job(config: JobConfig, workload: str
             flag_rounds=flag_rounds, flag_s=flag_s,
             resumed_chunks=resumed)
     else:
-        hi, lo, vals, n = engine.finalize()
+        with obs.phase("finalize"):
+            hi, lo, vals, n = engine.finalize()
         live = ~((hi == np.uint32(SENTINEL)) & (lo == np.uint32(SENTINEL)))
         k64 = join_u64(hi[live], lo[live])
         if k64.shape[0] != n:
@@ -677,11 +715,13 @@ def run_distributed_job(config: JobConfig, workload: str
         if config.output_path:
             from map_oxidize_tpu.io.writer import write_final_result
 
-            names = partition_strings(list(counts), dictionary,
-                                      engine.proc, P_)
-            write_final_result(
-                partition_output_path(config.output_path, engine.proc, P_),
-                ((b, counts[h]) for h, b in names.items()))
+            with obs.phase("write"):
+                names = partition_strings(list(counts), dictionary,
+                                          engine.proc, P_)
+                write_final_result(
+                    partition_output_path(config.output_path, engine.proc,
+                                          P_),
+                    ((b, counts[h]) for h, b in names.items()))
         result = DistributedResult(
             counts=counts, top=top, n_keys=n, records=records,
             flag_rounds=flag_rounds, flag_s=flag_s,
@@ -690,22 +730,97 @@ def run_distributed_job(config: JobConfig, workload: str
         ckpt.finish(config.keep_intermediates)
     registry.set("records_in", records)
     registry.set("flag_rounds", flag_rounds)
-    result.metrics = registry.summary()
-    if config.metrics_out:
-        # one document per process (counters are per-process facts); the
-        # suffix keeps P writers off one file
-        from map_oxidize_tpu.obs import write_json_atomic
-
-        path = (config.metrics_out if P_ <= 1
-                else f"{config.metrics_out}.proc{engine.proc}")
-        write_json_atomic(path, registry.to_dict())
+    registry.set("device_rows_fed",
+                 engine._eng.rows_fed if hasattr(engine, "_eng")
+                 else engine.rows_fed)
+    result.metrics, result.trace = finish_distributed_obs(obs, config,
+                                                          workload)
     _log.info("distributed %s: %d processes, %d local records, %d keys, "
               "%d lockstep flag rounds (%.3fs)", workload, P_, records,
               result.n_keys, flag_rounds, flag_s)
     return result
 
 
-def _run_distributed_distinct(config: JobConfig) -> DistributedResult:
+def finish_distributed_obs(obs: Obs, config: JobConfig, workload: str
+                           ) -> "tuple[dict, list | None]":
+    """The multi-process twin of ``Obs.finish``: final watermarks, the
+    per-process metrics document (``<metrics_out>.proc<i>``), the trace
+    shard (``<trace_out>.proc<i>``, schema :data:`obs.merge.SHARD_SCHEMA`),
+    a shard barrier, process 0's auto-merge (one Chrome trace + skew
+    report) when shards share a filesystem, and process 0's ledger
+    append.  Returns the same ``(summary, trace_events)`` pair as
+    ``Obs.finish`` — to which the degenerate single-process case
+    delegates outright, so the two export paths cannot drift."""
+    if obs.n_processes <= 1:
+        return obs.finish(config, workload)
+
+    from map_oxidize_tpu.obs import write_json_atomic
+    from map_oxidize_tpu.obs.metrics import (
+        sample_device_memory,
+        sample_host_memory,
+    )
+
+    sample_host_memory(obs.registry)
+    sample_device_memory(obs.registry)
+    if obs.heartbeat is not None:
+        obs.heartbeat.final_beat()
+    P_ = obs.n_processes
+    meta = obs.stamp(config, workload)
+    metrics_doc = dict(obs.registry.to_dict(), meta=meta)
+    if config.metrics_out:
+        # one document per process (counters are per-process facts); the
+        # suffix keeps P writers off one file
+        write_json_atomic(f"{config.metrics_out}.proc{obs.process}",
+                          metrics_doc)
+    trace = obs.tracer.chrome_trace() if obs.tracer.enabled else None
+    if trace is not None:
+        trace.insert(0, {"name": "moxt_meta", "ph": "M",
+                         "pid": obs.tracer._pid, "tid": 0, "args": meta})
+    skew = None
+    if trace is not None and config.trace_out != "-":
+        from map_oxidize_tpu.obs.merge import shard_path, write_shard
+
+        write_shard(shard_path(config.trace_out, obs.process), meta,
+                    trace, metrics_doc)
+        # Rendezvous so process 0 reads only durably-written shards.
+        # Best-effort: a peer that died AFTER its last engine collective
+        # never reaches this barrier, and the coordination service then
+        # fails it here — this process's shard, outputs, and metrics are
+        # already on disk at that point, so only the auto-merge is lost,
+        # not the evidence (re-merge by hand: `obs merge <trace_out>`).
+        try:
+            _obs_barrier()
+            if obs.process == 0:
+                from map_oxidize_tpu.obs import merge as obs_merge
+
+                skew = obs_merge.maybe_merge_at_job_end(config, 0, P_)
+        except Exception as e:  # evidence must not fail the job
+            _log.warning("obs shard barrier/merge failed (%s); merge by "
+                         "hand: python -m map_oxidize_tpu obs merge %s",
+                         e, config.trace_out)
+    summary = obs.registry.summary()
+    if obs.process == 0 and getattr(config, "ledger_dir", None):
+        from map_oxidize_tpu.obs import ledger
+
+        extra = {}
+        if skew:
+            extra = {"records_total": skew.get("records_total"),
+                     "skew": skew.get("skew")}
+        ledger.append(config.ledger_dir, ledger.build_entry(
+            config, workload, summary, n_processes=P_, extra=extra))
+    return summary, trace
+
+
+def _obs_barrier() -> None:
+    """Cross-process rendezvous before process 0 reads the other
+    processes' shard files."""
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices("moxt_obs_shards")
+
+
+def _run_distributed_distinct(config: JobConfig, obs: Obs
+                              ) -> DistributedResult:
     """Distributed HLL: each process folds its chunk subset into local
     registers; ONE allgather max-merges them (registers are a max monoid —
     the merge is exact, the estimate is the union's)."""
@@ -727,27 +842,41 @@ def _run_distributed_distinct(config: JobConfig) -> DistributedResult:
     # DistinctMapper owns the tokenizer semantics AND the graceful
     # native-unavailable fallback (stream_or_none)
     mapper = DistinctMapper(config.tokenizer, config.use_native, p)
-    for _i, chunk, _base in _local_chunks(config, proc, n_proc, False):
-        out = mapper.map_chunk(bytes(chunk))
-        np.maximum.at(registers, np.asarray(out.lo, np.int64),
-                      np.asarray(out.values, np.int32))
-        records += out.records_in
-    all_regs = np.asarray(multihost_utils.process_allgather(registers))
-    if all_regs.ndim == 1:
-        all_regs = all_regs[None]
-    merged = all_regs.max(axis=0).astype(np.int32)
-    est = hll_estimate(merged)
+    with obs.phase("map+reduce"):
+        for _i, chunk, base in _local_chunks(config, proc, n_proc, False):
+            with obs.tracer.span("dist/map_chunk", index=_i,
+                                 bytes=len(chunk)):
+                out = mapper.map_chunk(bytes(chunk))
+            np.maximum.at(registers, np.asarray(out.lo, np.int64),
+                          np.asarray(out.values, np.int32))
+            records += out.records_in
+            if obs.heartbeat is not None:
+                obs.heartbeat.update(rows=out.records_in,
+                                     bytes_done=base + len(chunk))
+    with obs.phase("finalize"):
+        all_regs = np.asarray(multihost_utils.process_allgather(registers))
+        if all_regs.ndim == 1:
+            all_regs = all_regs[None]
+        merged = all_regs.max(axis=0).astype(np.int32)
+        est = hll_estimate(merged)
     if config.output_path and proc == 0:
         # merged registers are replicated, so one writer suffices and the
         # file is byte-identical to the single-process driver's
         from map_oxidize_tpu.workloads.distinct import write_distinct_output
 
-        write_distinct_output(config.output_path, merged, float(est), p)
-    return DistributedResult(counts=None, top=[], n_keys=0,
-                             records=records, estimate=float(est))
+        with obs.phase("write"):
+            write_distinct_output(config.output_path, merged, float(est), p)
+    obs.registry.set("records_in", records)
+    obs.registry.set("registers_filled", int(np.count_nonzero(merged)))
+    result = DistributedResult(counts=None, top=[], n_keys=0,
+                               records=records, estimate=float(est))
+    result.metrics, result.trace = finish_distributed_obs(obs, config,
+                                                          "distinct")
+    return result
 
 
-def _run_distributed_kmeans(config: JobConfig) -> DistributedResult:
+def _run_distributed_kmeans(config: JobConfig, obs: Obs
+                            ) -> DistributedResult:
     """Multi-process k-means: the SAME jitted psum iteration the
     single-controller sharded fit runs (:func:`parallel.kmeans.make_fit_fn`
     — one XLA program, so the paths cannot drift), with the points array
@@ -807,23 +936,34 @@ def _run_distributed_kmeans(config: JobConfig) -> DistributedResult:
     w_local[:take] = 1.0
 
     row = NamedSharding(mesh, P(SHARD_AXIS))
-    p_dev = jax.make_array_from_process_local_data(row, local, (n_pad, d))
-    w_dev = jax.make_array_from_process_local_data(row, w_local, (n_pad,))
+    with obs.phase("transfer"):
+        p_dev = jax.make_array_from_process_local_data(row, local,
+                                                       (n_pad, d))
+        w_dev = jax.make_array_from_process_local_data(row, w_local,
+                                                       (n_pad,))
     fit_fn = make_fit_fn(mesh, k, d, config.kmeans_iters,
                          config.kmeans_precision)
     rep = jax.jit(lambda x: x, out_shardings=NamedSharding(mesh, P()))
-    out = np.asarray(rep(fit_fn(p_dev, w_dev,
-                                jax.device_put(centroids,
-                                               NamedSharding(mesh, P())))))
+    with obs.phase("iterate"):
+        out = np.asarray(rep(fit_fn(
+            p_dev, w_dev,
+            jax.device_put(centroids, NamedSharding(mesh, P())))))
     if config.output_path and proc == 0:
         from map_oxidize_tpu.workloads.kmeans import write_centroids
 
-        write_centroids(config.output_path, out)
+        with obs.phase("write"):
+            write_centroids(config.output_path, out)
     _log.info("distributed kmeans: %d processes, %d points, k=%d, %d "
               "iterations", n_proc, n, k, config.kmeans_iters)
-    return DistributedResult(counts=None, top=[], n_keys=0,
-                             records=int(take) * config.kmeans_iters,
-                             centroids=out)
+    obs.registry.set("records_in", int(take) * config.kmeans_iters)
+    obs.registry.set("points", int(n))
+    obs.registry.set("iters", config.kmeans_iters)
+    result = DistributedResult(counts=None, top=[], n_keys=0,
+                               records=int(take) * config.kmeans_iters,
+                               centroids=out)
+    result.metrics, result.trace = finish_distributed_obs(obs, config,
+                                                          "kmeans")
+    return result
 
 
 def run_distributed_wordcount(config: JobConfig, workload: str = "wordcount"):

@@ -129,6 +129,13 @@ def run_sharded_device_job(config: JobConfig, ngram: int = 1) -> JobResult:
     steady-state work is streaming file bytes and the one packed dictionary
     fetch per group (pipelined one group behind, so it overlaps compute).
     """
+    config.validate()
+    obs = Obs.from_config(config)
+    with obs.recording(config, "bigram" if ngram == 2 else "wordcount"):
+        return _run_sharded_device_body(config, obs, ngram)
+
+
+def _run_sharded_device_body(config: JobConfig, obs, ngram: int) -> JobResult:
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from dataclasses import replace
@@ -140,8 +147,6 @@ def run_sharded_device_job(config: JobConfig, ngram: int = 1) -> JobResult:
     from map_oxidize_tpu.parallel.engine import ShardedReduceEngine
     from map_oxidize_tpu.parallel.mesh import SHARD_AXIS
 
-    config.validate()
-    obs = Obs.from_config(config)
     metrics = obs.registry
     N = config.chunk_bytes
     max_tokens = N // 2 + 1
@@ -271,7 +276,8 @@ def run_sharded_device_job(config: JobConfig, ngram: int = 1) -> JobResult:
     metrics.set("distinct_keys", len(counts))
     metrics.set("chunks", n_chunks)
     metrics.set("shards", S)
-    summary, trace = obs.finish(config)
+    summary, trace = obs.finish(config,
+                                "bigram" if ngram == 2 else "wordcount")
     result = JobResult(counts=counts, top=top, metrics=summary, trace=trace)
     if config.metrics:
         _log.info("metrics: %s", result.metrics)
@@ -341,6 +347,12 @@ def run_device_wordcount_job(config: JobConfig, ngram: int = 1) -> JobResult:
     """Word/n-gram count with the map phase on device (single chip)."""
     config.validate()
     obs = Obs.from_config(config)
+    with obs.recording(config, "bigram" if ngram == 2 else "wordcount"):
+        return _run_device_wordcount_body(config, obs, ngram)
+
+
+def _run_device_wordcount_body(config: JobConfig, obs,
+                               ngram: int) -> JobResult:
     metrics = obs.registry
     engine = DeviceReduceEngine(config, SumReducer())
     engine.obs = obs
@@ -414,7 +426,8 @@ def run_device_wordcount_job(config: JobConfig, ngram: int = 1) -> JobResult:
     metrics.set("records_in", dicts.records_in)
     metrics.set("distinct_keys", len(counts))
     metrics.set("chunks", n_chunks)
-    summary, trace = obs.finish(config)
+    summary, trace = obs.finish(config,
+                                "bigram" if ngram == 2 else "wordcount")
     result = JobResult(counts=counts, top=top, metrics=summary, trace=trace)
     if config.metrics:
         _log.info("metrics: %s", result.metrics)

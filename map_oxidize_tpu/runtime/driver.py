@@ -253,9 +253,20 @@ def run_wordcount_job(config: JobConfig, mapper: Mapper, reducer: Reducer,
 
     With ``config.checkpoint_dir`` set, every mapped chunk is spilled
     atomically and a re-run replays the spilled prefix instead of re-mapping
-    it (see :mod:`map_oxidize_tpu.runtime.checkpoint`)."""
+    it (see :mod:`map_oxidize_tpu.runtime.checkpoint`).
+
+    Any abort — the conservation/duplicate-key/overflow invariant checks
+    included — passes through the flight recorder (``obs.recording``): open
+    spans close, partial metrics/trace flush, and ``config.crash_dir`` gets
+    a post-mortem bundle before the exception propagates."""
     config.validate()
     obs = Obs.from_config(config)
+    with obs.recording(config, workload):
+        return _run_wordcount_body(config, obs, mapper, reducer, workload)
+
+
+def _run_wordcount_body(config: JobConfig, obs: Obs, mapper: Mapper,
+                        reducer: Reducer, workload: str) -> JobResult:
     metrics = obs.registry
 
     engine = make_engine(config, reducer,
@@ -404,7 +415,7 @@ def run_wordcount_job(config: JobConfig, mapper: Mapper, reducer: Reducer,
     metrics.set("distinct_keys", len(counts))
     metrics.set("chunks", n_chunks)
     metrics.set("device_rows_fed", engine.rows_fed)
-    summary, trace = obs.finish(config)
+    summary, trace = obs.finish(config, workload)
     result = JobResult(counts=counts, top=top, metrics=summary, trace=trace)
     if config.metrics:
         _log.info("metrics: %s", result.metrics)
@@ -441,14 +452,20 @@ def run_inverted_index_job(config: JobConfig) -> InvertedIndexResult:
     Output file: one line per term, ``term\\td1 d2 d3...``, terms in byte
     order — deterministic, unlike anything the reference's nondeterministic
     HashMap ordering could produce (main.rs:170-182)."""
+    config.validate()
+    obs = Obs.from_config(config)
+    with obs.recording(config, "invertedindex"):
+        return _run_inverted_index_body(config, obs)
+
+
+def _run_inverted_index_body(config: JobConfig, obs: Obs
+                             ) -> InvertedIndexResult:
     from map_oxidize_tpu.workloads.inverted_index import (
         Postings,
         make_inverted_index,
         postings_from_sorted,
     )
 
-    config.validate()
-    obs = Obs.from_config(config)
     metrics = obs.registry
     mapper = make_inverted_index(config.tokenizer, config.use_native)
     if effective_num_shards(config) > 1:
@@ -576,7 +593,7 @@ def _finish_inverted_index(config, obs, postings, ckpt, records_in,
     metrics.set("pairs", int(postings.n_pairs))
     metrics.set("distinct_terms", len(postings))
     metrics.set("chunks", n_chunks)
-    summary, trace = obs.finish(config)
+    summary, trace = obs.finish(config, "invertedindex")
     result = InvertedIndexResult(postings=postings, metrics=summary,
                                  trace=trace)
     if config.metrics:
@@ -673,14 +690,20 @@ def run_kmeans_job(config: JobConfig, centroids: np.ndarray | None = None
     Input: a ``.npy`` float32 ``(n, d)`` points file, memory-mapped and
     streamed by row ranges.  Initial centroids default to the first
     ``kmeans_k`` points (deterministic)."""
+    config.validate()
+    obs = Obs.from_config(config)
+    with obs.recording(config, "kmeans"):
+        return _run_kmeans_body(config, obs, centroids)
+
+
+def _run_kmeans_body(config: JobConfig, obs: Obs,
+                     centroids: np.ndarray | None) -> KMeansResult:
     from map_oxidize_tpu.api import SumReducer
     from map_oxidize_tpu.workloads.kmeans import (
         iter_point_chunks,
         kmeans_iteration,
     )
 
-    config.validate()
-    obs = Obs.from_config(config)
     metrics = obs.registry
     pts = np.load(config.input_path, mmap_mode="r")
     if pts.ndim != 2:
@@ -886,7 +909,7 @@ def run_kmeans_job(config: JobConfig, centroids: np.ndarray | None = None
     metrics.set("iters", start_iter + ran_iters)
     if start_iter:
         metrics.set("resumed_iters", start_iter)
-    summary, trace = obs.finish(config)
+    summary, trace = obs.finish(config, "kmeans")
     result = KMeansResult(centroids=centroids, metrics=summary, trace=trace)
     if config.metrics:
         _log.info("metrics: %s", result.metrics)
@@ -918,6 +941,13 @@ def run_distinct_job(config: JobConfig) -> DistinctResult:
     there is (fixed tiny key space, no dictionary, no growth), shared
     between the single-chip fold and the sharded mesh engine unchanged.
     See :mod:`map_oxidize_tpu.workloads.distinct` for the formulation."""
+    config.validate()
+    obs = Obs.from_config(config)
+    with obs.recording(config, "distinct"):
+        return _run_distinct_body(config, obs)
+
+
+def _run_distinct_body(config: JobConfig, obs: Obs) -> DistinctResult:
     from map_oxidize_tpu import runtime as _rt
     from map_oxidize_tpu.api import MaxReducer
     from map_oxidize_tpu.workloads.distinct import (
@@ -925,8 +955,6 @@ def run_distinct_job(config: JobConfig) -> DistinctResult:
         hll_estimate,
     )
 
-    config.validate()
-    obs = Obs.from_config(config)
     metrics = obs.registry
     p = config.hll_precision
     m = 1 << p
@@ -1037,7 +1065,7 @@ def run_distinct_job(config: JobConfig) -> DistinctResult:
     metrics.set("records_in", records_in)
     metrics.set("chunks", n_chunks)
     metrics.set("registers_filled", int(np.count_nonzero(regs)))
-    summary, trace = obs.finish(config)
+    summary, trace = obs.finish(config, "distinct")
     result = DistinctResult(estimate=estimate, registers=regs,
                             metrics=summary, trace=trace)
     if config.metrics:

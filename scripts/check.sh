@@ -1,0 +1,50 @@
+#!/usr/bin/env bash
+# One-command verification: the tier-1 test suite plus an observability
+# smoke that exercises the whole artifact surface — a tiny wordcount with
+# --trace-out/--metrics-out/--ledger-dir (twice, so the ledger has a
+# previous entry), artifact well-formedness checks, an informational
+# previous-vs-last `obs diff`, and a gated self-diff that must report
+# zero deltas.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== tier-1 pytest =="
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+
+echo "== obs smoke =="
+smoke=$(mktemp -d)
+trap 'rm -rf "$smoke"' EXIT
+python - "$smoke" <<'EOF'
+import sys
+with open(f"{sys.argv[1]}/corpus.txt", "wb") as f:
+    f.write(b"the quick brown fox jumps over the lazy dog\n" * 200)
+EOF
+for _ in 1 2; do
+    JAX_PLATFORMS=cpu python -m map_oxidize_tpu wordcount \
+        "$smoke/corpus.txt" --output "$smoke/out.txt" --num-shards 1 \
+        --quiet --trace-out "$smoke/trace.json" \
+        --metrics-out "$smoke/metrics.json" --ledger-dir "$smoke/ledger" \
+        > /dev/null
+done
+python - "$smoke" <<'EOF'
+import json, sys
+d = sys.argv[1]
+trace = json.load(open(f"{d}/trace.json"))
+assert isinstance(trace, list) and trace, "trace.json malformed"
+assert all(e["ph"] in ("X", "i", "M") for e in trace)
+m = json.load(open(f"{d}/metrics.json"))
+assert m["meta"]["config_hash"] and m["meta"]["version"], "stamp missing"
+assert m["phases_s"]["map+reduce"] > 0
+led = [json.loads(l) for l in open(f"{d}/ledger/ledger.jsonl")]
+assert len(led) == 2, f"expected 2 ledger entries, got {len(led)}"
+print("obs artifacts OK")
+EOF
+# previous vs last (informational: same config, tiny run — deltas are
+# jitter), then a gated self-diff that MUST come back all-zero
+python -m map_oxidize_tpu obs diff --ledger-dir "$smoke/ledger"
+python -m map_oxidize_tpu obs diff --ledger-dir "$smoke/ledger" \
+    --gate -- -1 -1
+echo "check.sh: ALL OK"
