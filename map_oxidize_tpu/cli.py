@@ -53,6 +53,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--chunk-mb", type=int, default=32, help="streamed chunk size")
     p.add_argument("--batch-size", type=int, default=1 << 20,
                    help="device feed batch rows")
+    p.add_argument("--pipeline-depth", type=int, default=2,
+                   help="bounded-prefetch pipeline depth: chunks of host "
+                        "read+tokenize allowed to run ahead of the device "
+                        "feed (1 = strictly serial; outputs are "
+                        "byte-identical at any depth)")
     p.add_argument("--key-capacity", type=int, default=1 << 22,
                    help="max distinct keys on device")
     p.add_argument("--backend", choices=["auto", "cpu", "tpu"], default="auto")
@@ -98,6 +103,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="device-path matmul precision: f32-emulating "
                         "HIGHEST (oracle parity) or native single-pass "
                         "bf16 MXU matmuls with f32 accumulation")
+    p.add_argument("--kmeans-fit-bytes", type=int, default=0,
+                   help="kmeans mapper=auto device-fit budget in bytes; "
+                        "past it the job streams through the device "
+                        "(0 = probe the device's memory)")
     p.add_argument("--dist-coordinator", default="",
                    help="multi-host: coordination address host:port (same "
                         "on every process); enables jax.distributed")
@@ -152,6 +161,7 @@ def config_from_args(args: argparse.Namespace) -> JobConfig:
         num_chunks=args.num_chunks,
         chunk_bytes=args.chunk_mb * 1024 * 1024,
         batch_size=args.batch_size,
+        pipeline_depth=args.pipeline_depth,
         key_capacity=args.key_capacity,
         backend=args.backend,
         num_shards=args.num_shards,
@@ -179,6 +189,7 @@ def config_from_args(args: argparse.Namespace) -> JobConfig:
         kmeans_k=args.kmeans_k,
         kmeans_iters=args.kmeans_iters,
         kmeans_precision=args.kmeans_precision,
+        kmeans_device_fit_bytes=args.kmeans_fit_bytes,
     ).validate()
 
 

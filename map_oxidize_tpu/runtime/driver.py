@@ -24,6 +24,7 @@ from map_oxidize_tpu.obs import Obs
 from map_oxidize_tpu.ops.hashing import SENTINEL, HashDictionary, join_u64
 from map_oxidize_tpu.runtime.engine import DeviceReduceEngine, StreamingEngineBase
 from map_oxidize_tpu.runtime.executor import run_map_phase
+from map_oxidize_tpu.runtime.pipeline import pipelined
 from map_oxidize_tpu.utils.logging import get_logger
 
 _log = get_logger(__name__)
@@ -368,15 +369,22 @@ def _run_wordcount_body(config: JobConfig, obs: Obs, mapper: Mapper,
                     resume_off, offsets, resume_k)
 
     # --- map + reduce, fused streaming phase (main.rs:19-22 were barriered)
+    # The pipeline wrapper runs the host half (C++ scan / python map) in a
+    # bounded prefetch thread so chunk i+1's read+tokenize overlaps chunk
+    # i's engine feed + dispatch below; order is preserved, so the
+    # checkpoint spill and the output are byte-identical to depth 1.
     with obs.phase("map+reduce"):
         if native_file_iter is not None:
-            for i, (out, next_off) in enumerate(native_file_iter):
+            it = pipelined(native_file_iter, config.pipeline_depth, obs,
+                           name="map")
+            for i, (out, next_off) in enumerate(it):
                 _ingest(out, next_off)
                 if ckpt is not None:
                     ckpt.save(resume_k + i, out, next_off)
         else:
             outputs = run_map_phase(
-                chunks, mapper, config.num_map_workers, config.max_retries
+                chunks, mapper, config.num_map_workers, config.max_retries,
+                pipeline_depth=config.pipeline_depth, obs=obs,
             )
             for idx, out in outputs:
                 gidx = resume_k + idx
@@ -531,6 +539,8 @@ def _run_inverted_index_body(config: JobConfig, obs: Obs
                     off += len(chunk)
                     yield mapper.map_docs(chunk, off - len(chunk)), off
             it = _host_iter()
+        # prefetch: doc-chunk read+tokenize overlaps the collect feed
+        it = pipelined(it, config.pipeline_depth, obs, name="map")
         for i, (out, next_off) in enumerate(it):
             _ingest(out, next_off)
             if ckpt is not None:
@@ -621,20 +631,24 @@ class KMeansResult:
 _KMEANS_DEVICE_FIT_BYTES = 8 << 30
 
 
-def _kmeans_device_fit_bytes(backend: str) -> int:
+def _kmeans_device_fit_bytes(config) -> int:
     """mapper='auto' picks the HBM-resident fit when the whole working set
     fits comfortably on one device: points (n*d*4) PLUS the (n, k)
     distance and one-hot intermediates (n*k*4 each) the device step
     materializes — i.e. 4*n*(d + 2k) bytes against this budget.  The
-    budget is HALF the device's reported memory (headroom for XLA's own
+    budget is ``config.kmeans_device_fit_bytes`` when set (the test/
+    operator override pinning the beyond-fit routing, VERDICT r5 #5),
+    else HALF the device's reported memory (headroom for XLA's own
     buffers and the fori_loop's double-buffered carries), falling back to
     8GB when the runtime doesn't expose memory stats (advisor r4: the
     old hardcoded 8GB assumed a 16GB chip and could OOM smaller ones).
     Beyond it, the job streams — the only option at that scale."""
+    if getattr(config, "kmeans_device_fit_bytes", 0):
+        return config.kmeans_device_fit_bytes
     try:
         from map_oxidize_tpu.runtime.engine import pick_device
 
-        stats = pick_device(backend).memory_stats()
+        stats = pick_device(config.backend).memory_stats()
         total = int(stats.get("bytes_limit", 0))
         if total > 0:
             return total // 2
@@ -727,7 +741,7 @@ def _run_kmeans_body(config: JobConfig, obs: Obs,
         # assign engine (~2x) and, in bf16, the NumPy baseline at the
         # multi-GB scale this regime is about (RESULTS.md round 5)
         fits = (4 * int(n) * (int(d) + 2 * config.kmeans_k)
-                <= _kmeans_device_fit_bytes(config.backend))
+                <= _kmeans_device_fit_bytes(config))
         mode = "device" if fits else "stream_device"
         if config.checkpoint_dir:
             # an existing snapshot's mode wins over the heuristic: resume
@@ -750,7 +764,14 @@ def _run_kmeans_body(config: JobConfig, obs: Obs,
     else:
         mode = "stream"
     device_mode = mode == "device"
-    n_shards = effective_num_shards(config) if device_mode else 1
+    # streaming composes with the mesh now: stream_device shards each
+    # chunk across every visible device (num_shards=1 pins one chip), so
+    # the shard count is checkpoint identity for it exactly as for the
+    # resident fit
+    n_shards = (effective_num_shards(config)
+                if mode in ("device", "stream_device") else 1)
+    metrics.set("kmeans_mode", mode)
+    metrics.set("kmeans_shards", n_shards)
 
     # --- checkpoint/resume: the iteration boundary is k-means's natural
     # materialization barrier (centroids fully summarize progress), so the
@@ -824,9 +845,7 @@ def _run_kmeans_body(config: JobConfig, obs: Obs,
                     "requested; returning the snapshotted state",
                     start_iter, config.kmeans_iters)
         elif mode == "stream_device":
-            from map_oxidize_tpu.workloads.kmeans import (
-                kmeans_fit_streamed_device,
-            )
+            from map_oxidize_tpu.parallel.kmeans import kmeans_fit_streamed
 
             from map_oxidize_tpu.runtime.engine import pick_device
 
@@ -837,19 +856,37 @@ def _run_kmeans_body(config: JobConfig, obs: Obs,
             # points block plus the (chunk, k) distance and one-hot
             # intermediates — the same 4*(d + 2k) accounting as the fit
             # heuristic, else a large-k job would OOM the chip with the
-            # very path meant to avoid that.
+            # very path meant to avoid that.  (Per CHUNK, not per shard:
+            # the budget is conservative for a multi-device mesh, where
+            # each shard sees chunk_rows/S of it.)
             chunk_rows = max(1, max(config.chunk_bytes, 256 << 20)
                              // (4 * (int(d) + 2 * config.kmeans_k)))
             timings: dict = {}
-            centroids = kmeans_fit_streamed_device(
-                config.input_path, centroids, iters=remaining,
-                chunk_rows=chunk_rows,
-                device=pick_device(config.backend),
-                precision=config.kmeans_precision,
-                timings=timings,
-                on_iter=_iter_done if want_iter_cb else None)
+            kw = dict(iters=remaining, chunk_rows=chunk_rows,
+                      precision=config.kmeans_precision, timings=timings,
+                      on_iter=_iter_done if want_iter_cb else None,
+                      pipeline_depth=config.pipeline_depth)
+            if n_shards > 1:
+                # streaming x sharding composed: each chunk's put splits
+                # across the mesh and the step is the shared one-psum
+                # program (parallel/kmeans.make_stream_step_fn)
+                centroids = kmeans_fit_streamed(
+                    config.input_path, centroids,
+                    num_shards=config.num_shards, backend=config.backend,
+                    **kw)
+            else:
+                centroids = kmeans_fit_streamed(
+                    config.input_path, centroids,
+                    device=pick_device(config.backend), **kw)
             for tk, tv in timings.items():
-                metrics.set(f"time/{tk}", round(tv, 4))
+                # the prefetcher's overlap evidence lands under the SAME
+                # keys/units every other pipelined path uses
+                if tk == "overlap_ratio":
+                    metrics.set("pipeline/overlap_ratio", tv)
+                elif tk == "feed_wait_s":
+                    metrics.count("pipeline/feed_wait_ms", tv * 1e3)
+                else:
+                    metrics.set(f"time/{tk}", round(tv, 4))
         elif device_mode:
             on_iter = _iter_done if want_iter_cb else None
             if n_shards > 1:
@@ -877,13 +914,22 @@ def _run_kmeans_body(config: JobConfig, obs: Obs,
                 for tk, tv in timings.items():
                     metrics.set(f"time/{tk}", round(tv, 4))
         else:
+            from map_oxidize_tpu.workloads.kmeans import KMeansMapper
+
             for it in range(start_iter, config.kmeans_iters):
                 engine = make_engine(config, SumReducer(),
                                      value_shape=(d + 1,),
                                      value_dtype=np.float32)
+                # the host assign (map_chunk) runs in the prefetch
+                # thread, so assigning chunk i+1 overlaps chunk i's
+                # engine feed + device dispatch
+                mapper = KMeansMapper(centroids)
+                mapped = pipelined(
+                    (mapper.map_chunk(c) for c in
+                     iter_point_chunks(config.input_path, rows)),
+                    config.pipeline_depth, obs, name="kmeans/map")
                 centroids = kmeans_iteration(
-                    engine, centroids,
-                    iter_point_chunks(config.input_path, rows))
+                    engine, centroids, (), mapper=mapper, mapped=mapped)
                 if want_iter_cb:
                     _iter_done(it + 1 - start_iter,
                                centroids if store else None)
@@ -1025,14 +1071,18 @@ def _run_distinct_body(config: JobConfig, obs: Obs) -> DistinctResult:
 
     with obs.phase("map+reduce"):
         if file_iter is not None:
-            for i, (out, next_off) in enumerate(file_iter):
+            it = pipelined(file_iter, config.pipeline_depth, obs,
+                           name="map")
+            for i, (out, next_off) in enumerate(it):
                 _ingest(out, next_off)
                 if ckpt is not None:
                     ckpt.save(resume_k + i, out, next_off)
         else:
             for idx, out in run_map_phase(chunks, mapper,
                                           config.num_map_workers,
-                                          config.max_retries):
+                                          config.max_retries,
+                                          pipeline_depth=config.pipeline_depth,
+                                          obs=obs):
                 gidx = resume_k + idx
                 _ingest(out, offsets.get(gidx))
                 if ckpt is not None:

@@ -203,7 +203,7 @@ class StreamingEngineBase(abc.ABC):
                     self._merge_batch(self._pad(hi, lo, vals, start, stop))
                     self._merges += 1
                     if self._merges % self._check_every == 0:
-                        self._check_health()
+                        self._health_sync()
         finally:
             if obs is not None:
                 obs.registry.observe("engine/flush_ms",
@@ -241,8 +241,16 @@ class StreamingEngineBase(abc.ABC):
         if self._n_unique is not None:
             # growth looks necessary — refresh the bound from the device
             # first (the only sync on the feed path, and only at a growth
-            # edge the hint couldn't rule out)
+            # edge the hint couldn't rule out).  The block is a pipeline
+            # stall — the host sits in it while the prefetch thread piles
+            # up behind the feed — so it is timed into the obs bundle as
+            # feed-wait evidence at the engine layer.
+            t0 = time.perf_counter()
             self._n_live_ub = self._read_live()
+            if self.obs is not None:
+                self.obs.registry.observe(
+                    "engine/growth_sync_ms",
+                    (time.perf_counter() - t0) * 1e3)
             needed = self._n_live_ub + incoming
             if self._total_hint is not None:
                 needed = min(needed, self._total_hint)
@@ -264,6 +272,19 @@ class StreamingEngineBase(abc.ABC):
             self.obs.tracer.instant("engine/grow", old=self.capacity,
                                     new=new_cap)
         self.capacity = new_cap
+
+    def _health_sync(self) -> None:
+        """Periodic overflow check on the feed path, timed: the host
+        blocks here for the device (the one *mandatory* sync between
+        merges), which is exactly the stall the streaming pipeline's
+        ``feed_wait`` accounting wants attributed — a high
+        ``engine/health_sync_ms`` means the device, not the host map, is
+        the pipeline's limiting stage."""
+        t0 = time.perf_counter()
+        self._check_health()
+        if self.obs is not None:
+            self.obs.registry.observe("engine/health_sync_ms",
+                                      (time.perf_counter() - t0) * 1e3)
 
     @abc.abstractmethod
     def _read_live(self) -> int:

@@ -53,19 +53,32 @@ def run_map_phase(
     mapper: Mapper,
     num_workers: int,
     max_retries: int = 2,
+    pipeline_depth: int = 1,
+    obs=None,
 ) -> Iterator[tuple[int, MapOutput]]:
     """Map chunks concurrently; yield ``(chunk_index, MapOutput)`` in
     completion order.  At most ``2 * num_workers`` chunks are in flight, which
     bounds host memory and backpressures the input reader.
 
     With one worker (or one host core — where extra threads only add
-    scheduler churn) the pool is skipped entirely and chunks map inline."""
+    scheduler churn) the pool is skipped and chunks map inline — UNLESS
+    ``pipeline_depth > 1``, in which case the inline map runs in a
+    :mod:`~map_oxidize_tpu.runtime.pipeline` prefetch thread so chunk
+    i+1's read+tokenize overlaps chunk i's engine feed in the caller.
+    With the pool active, the pool already overlaps mapping; the pipeline
+    instead read-aheads the *chunk input* (disk/page-cache) by
+    ``pipeline_depth`` so the submit loop never stalls on I/O."""
     import os
 
+    from map_oxidize_tpu.runtime.pipeline import pipelined
+
     if num_workers <= 1 or (os.cpu_count() or 1) <= 1:
-        for idx, chunk in enumerate(chunks):
-            yield idx, _attempt(mapper, chunk, idx, max_retries)
+        def _inline():
+            for idx, chunk in enumerate(chunks):
+                yield idx, _attempt(mapper, chunk, idx, max_retries)
+        yield from pipelined(_inline(), pipeline_depth, obs, name="map")
         return
+    chunks = pipelined(chunks, pipeline_depth, obs, name="read")
     max_inflight = max(2, 2 * num_workers)
     with ThreadPoolExecutor(max_workers=num_workers, thread_name_prefix="map") as pool:
         inflight: dict[Future, int] = {}

@@ -89,17 +89,24 @@ def iter_point_chunks(path: str, rows_per_chunk: int):
 
 
 def kmeans_iteration(engine, centroids: np.ndarray, chunks,
-                     mapper: "KMeansMapper | None" = None) -> np.ndarray:
+                     mapper: "KMeansMapper | None" = None,
+                     mapped=None) -> np.ndarray:
     """One streamed iteration: feed every chunk's partial sums through the
     engine, reduce on device, return updated centroids.  Empty centroids
     keep their previous position (documented choice; the reference has no
-    analogous case)."""
+    analogous case).
+
+    ``mapped`` (an iterable of MapOutputs) replaces the chunk+map loop
+    when the caller runs the host assign elsewhere — the driver passes a
+    prefetch-pipelined map stream here so assigning chunk i+1 overlaps
+    chunk i's engine feed."""
     centroids = np.asarray(centroids, np.float32)
-    if mapper is None:
-        mapper = KMeansMapper(centroids)
+    if mapped is None:
+        if mapper is None:
+            mapper = KMeansMapper(centroids)
+        mapped = (mapper.map_chunk(chunk) for chunk in chunks)
     n_points = 0
-    for chunk in chunks:
-        out = mapper.map_chunk(chunk)
+    for out in mapped:
         n_points += out.records_in
         engine.feed(out)
     hi, lo, vals, n = engine.finalize()
@@ -300,15 +307,20 @@ def _kmeans_fit(c, p, k, iters, precision="highest"):
 def kmeans_fit_streamed_device(path: str, centroids: np.ndarray,
                                iters: int = 1, chunk_rows: int = 1 << 21,
                                device=None, precision: str = "highest",
-                               timings: dict | None = None, on_iter=None):
+                               timings: dict | None = None, on_iter=None,
+                               pipeline_depth: int = 2):
     """Beyond-HBM k-means with DEVICE assignment: points stream through
     the chip in fixed-row chunks each iteration — SURVEY §7 hard part
-    (c)'s double-buffered formulation.  The host loop issues chunk i's
-    assign/partial-sum and immediately starts preparing and putting chunk
-    i+1 (jax dispatch and ``device_put`` are asynchronous), so the
-    host->device transfer of the next chunk overlaps the current chunk's
-    MXU work; the ``(k, d+1)`` accumulator is donated across chunk steps,
-    and only the tiny centroid update crosses back per iteration.
+    (c)'s double-buffered formulation, now the 1-device mesh case of
+    :func:`map_oxidize_tpu.parallel.kmeans.kmeans_fit_streamed` (the
+    psum over a singleton shard axis degenerates, so single-device and
+    sharded streaming run the SAME jitted program and cannot drift).
+    The host block prep (fault-in + pad + cast) runs in a bounded
+    prefetch thread (``pipeline_depth``) so preparing chunk i+1 overlaps
+    chunk i's transfer+MXU work; ``device_put`` and the step dispatch
+    are already async, and the ``(k, d+1)`` accumulator is donated
+    across chunk steps, so only the tiny centroid update crosses back
+    per iteration.
 
     Contrast :func:`kmeans_iteration` (host-assign streaming: the NumPy
     assign competes with the baseline on the same core) and
@@ -320,8 +332,9 @@ def kmeans_fit_streamed_device(path: str, centroids: np.ndarray,
     the NumPy baseline from both sides; benchmarks record both regimes.
 
     ``timings``: ``feed_s`` (host wall of the full chunk loop, transfer
-    included) per the streamed contract — there is no transfer/compute
-    split to report because overlap is the point.
+    included) plus the prefetcher's ``feed_wait_s``/``overlap_ratio``;
+    there is no transfer/compute split to report because overlap is the
+    point.
 
     Dispatch economy is the design driver on the measured deployment:
     each separately launched executable costs ~150-250 ms through the
@@ -331,98 +344,17 @@ def kmeans_fit_streamed_device(path: str, centroids: np.ndarray,
     first chunk's step and the centroid update into the last chunk's
     (static first/last flags), and the all-ones weight column for full
     chunks is a cached device-resident constant, not a per-chunk put."""
-    import time
-
     import jax
 
-    pts = np.load(path, mmap_mode="r")
-    n, d = pts.shape
-    centroids = np.asarray(centroids, np.float32)
-    k = centroids.shape[0]
+    from map_oxidize_tpu.parallel.kmeans import kmeans_fit_streamed
+
     if device is None:
         device = jax.devices()[0]
-    cast = None
-    if precision == "bf16":
-        import ml_dtypes
-
-        cast = ml_dtypes.bfloat16
-    step = _stream_jitted()
-    # never compile/pad past the dataset: a chunk larger than n would
-    # zero-pad to the full shape and compute over mostly padding
-    chunk_rows = min(chunk_rows, n)
-    ones_w = jax.device_put(np.ones(chunk_rows, np.float32), device)
-    zero_acc = np.zeros((k, d + 1), np.float32)
-    starts = list(range(0, n, chunk_rows))
-
-    c_dev = jax.device_put(centroids, device)
-    t0 = time.perf_counter()
-    for it in range(iters):
-        acc = jax.device_put(zero_acc, device)  # donated by the first step
-        for j, start in enumerate(starts):
-            block = np.asarray(pts[start:start + chunk_rows], np.float32)
-            if block.shape[0] < chunk_rows:
-                # pad to the ONE compiled shape; the zero WEIGHT is what
-                # nulls a padding row (a zero vector alone would still
-                # count 1 toward whichever centroid it lands on) — same
-                # contract as the sharded fit
-                w_np = np.zeros(chunk_rows, np.float32)
-                w_np[:block.shape[0]] = 1.0
-                block = np.concatenate(
-                    [block, np.zeros((chunk_rows - block.shape[0], d),
-                                     np.float32)])
-                w = jax.device_put(w_np, device)
-            else:
-                w = ones_w
-            if cast is not None:
-                block = block.astype(cast)
-            b_dev = jax.device_put(block, device)  # async: overlaps compute
-            out = step(b_dev, w, c_dev, acc, k, precision,
-                       j == 0, j == len(starts) - 1)
-            if j == len(starts) - 1:
-                c_dev = out
-            else:
-                acc = out
-        if on_iter is not None:
-            # snapshot hook: one extra fetch per iteration, only when
-            # checkpointing asked for it
-            on_iter(it + 1, np.asarray(c_dev))
-    out = np.asarray(c_dev)  # forces the whole chain
-    if timings is not None:
-        timings["feed_s"] = time.perf_counter() - t0
-    return out
-
-
-_STREAM_JIT: dict = {}
-
-
-def _stream_jitted():
-    """Module-level jit wrapper for the device-streamed chunk step (same
-    persistence rationale as :func:`_make_jitted`: a fresh closure per
-    call would recompile every run — tens of seconds through the
-    tunnel — and pollute timed regions).  ``first`` folds the accumulator
-    init into the step; ``last`` folds the centroid update — one
-    dispatch per chunk, nothing else per iteration."""
-    if not _STREAM_JIT:
-        import functools
-
-        import jax
-        import jax.numpy as jnp
-
-        @functools.partial(jax.jit, static_argnums=(4, 5, 6, 7),
-                           donate_argnums=(3,))
-        def step(chunk, w, c, acc, kk, prec, first, last):
-            sums, counts = assign_and_sum(chunk, c, kk, prec, w)
-            part = jnp.concatenate([sums, counts[:, None]], axis=1)
-            acc = part if first else acc + part
-            if not last:
-                return acc
-            d = c.shape[1]
-            sums, counts = acc[:, :d], acc[:, d]
-            return jnp.where(counts[:, None] > 0,
-                             sums / jnp.maximum(counts[:, None], 1.0), c)
-
-        _STREAM_JIT["step"] = step
-    return _STREAM_JIT["step"]
+    return kmeans_fit_streamed(path, centroids, iters=iters,
+                               chunk_rows=chunk_rows, device=device,
+                               precision=precision, timings=timings,
+                               on_iter=on_iter,
+                               pipeline_depth=pipeline_depth)
 
 
 def write_centroids(path: str, centroids: np.ndarray) -> None:
