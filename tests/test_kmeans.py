@@ -482,3 +482,70 @@ def test_auto_routes_beyond_fit_to_streamed_device(tmp_path, rng,
     fresh = run_kmeans_job(dataclasses.replace(cfg, kmeans_iters=3))
     np.testing.assert_array_equal(resumed.centroids, fresh.centroids)
     assert resumed.metrics.get("resumed_iters") == 1
+
+
+def test_streamed_sharded_matches_oracle(tmp_path, rng):
+    """Streaming x sharding composed (VERDICT r5 #2): fixed-row chunks
+    stream as per-shard blocks through make_stream_step_fn's one-psum
+    program over the 8-virtual-device CPU mesh, and the result matches
+    the NumPy oracle — across chunking shapes (multi-chunk with a
+    padded, zero-weighted tail; the single-chunk first==last fusion)
+    and with a row count not divisible by the mesh."""
+    from map_oxidize_tpu.parallel.kmeans import kmeans_fit_streamed
+
+    pts, centers = _blobs(rng, n=5003, d=8, k=5)
+    pts[:5] = centers
+    path = tmp_path / "p.npy"
+    np.save(path, pts)
+    init = pts[:5].copy()
+    want = init
+    for _ in range(3):
+        want = kmeans_model(pts, want)
+    for chunk_rows in (1000, 1 << 20):  # multi-chunk+tail / single fused
+        got = kmeans_fit_streamed(str(path), init, iters=3,
+                                  chunk_rows=chunk_rows, num_shards=8,
+                                  backend="cpu")
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+    # shards=1 runs the SAME program (psum over a singleton axis) and
+    # must agree with the mesh run within float-reassociation tolerance
+    got1 = kmeans_fit_streamed(str(path), init, iters=3, chunk_rows=1000,
+                               num_shards=1, backend="cpu")
+    np.testing.assert_allclose(got1, want, rtol=1e-3, atol=1e-3)
+    # bf16 chunk storage stays within rounding of the f32 oracle
+    gb = kmeans_fit_streamed(str(path), init, iters=3, chunk_rows=1000,
+                             num_shards=8, backend="cpu",
+                             precision="bf16")
+    scale = float(np.abs(pts).max())
+    assert float(np.abs(gb - want).max()) <= 4 * 2.0**-8 * scale
+
+
+def test_fit_budget_config_routes_stream_device(tmp_path, rng):
+    """VERDICT r5 #5: the device-fit budget is a CONFIG field now —
+    forcing it tiny must route mapper='auto' to stream_device (recorded
+    in metrics, no monkeypatching) and still match the NumPy oracle;
+    a generous budget routes the same job to the resident fit."""
+    pts, centers = _blobs(rng, n=1200, d=5, k=3)
+    pts[:3] = centers
+    inp = tmp_path / "p.npy"
+    np.save(inp, pts)
+
+    def run(budget):
+        cfg = JobConfig(input_path=str(inp), output_path="", backend="cpu",
+                        kmeans_k=3, kmeans_iters=2, mapper="auto",
+                        metrics=True, kmeans_device_fit_bytes=budget)
+        return run_job(cfg, "kmeans")
+
+    want = pts[:3].copy()
+    for _ in range(2):
+        want = kmeans_model(pts, want)
+
+    streamed = run(budget=64)  # working set >> 64 bytes -> must stream
+    assert streamed.metrics["kmeans_mode"] == "stream_device"
+    np.testing.assert_allclose(streamed.centroids, want,
+                               rtol=1e-3, atol=1e-3)
+    assert "time/feed_s" in streamed.metrics
+
+    resident = run(budget=1 << 40)  # everything fits -> resident
+    assert resident.metrics["kmeans_mode"] == "device"
+    np.testing.assert_allclose(resident.centroids, want,
+                               rtol=1e-3, atol=1e-3)
