@@ -255,6 +255,13 @@ def main(argv=None) -> int:
     # host's ±15% single-thread drift and the 50-1200 MB/s link variance
     # (benchmarks/RESULTS.md) without re-running anything.
     probes = _session_probes()
+    # export the MEASURED matmul peak for the xprof MFU join: every job
+    # this process runs from here on quotes achieved FLOP/s against the
+    # session's sustained rate, not a nominal spec sheet number
+    if probes.get("matmul_peak_bf16_tflops"):
+        os.environ.setdefault(
+            "MOXT_PEAK_FLOPS",
+            str(probes["matmul_peak_bf16_tflops"] * 1e12))
 
     # --- CPU reference baseline: single-thread, reference semantics
     # (tokenize per main.rs:94-101, merge per main.rs:131-134), measured on a
@@ -459,9 +466,13 @@ def _bench_ledger_entries(headline, workloads) -> list:
         rate = next((e[k] for k in rate_keys if k in e), None)
         if rate is None:
             continue
-        entry = dict(
-            base, workload=f"bench/{name}",
-            metrics={"rate": rate, "vs_baseline": e.get("vs_baseline")})
+        metrics = {"rate": rate, "vs_baseline": e.get("vs_baseline")}
+        # XLA-layer gate fields ride along: a recompile or an MFU drop in
+        # a benchmarked workload fails --gate exactly like a rate drop
+        metrics.update({k: v for k, v in e.get("metrics_snapshot",
+                                               {}).items()
+                        if k.startswith(("compile/", "xprof/"))})
+        entry = dict(base, workload=f"bench/{name}", metrics=metrics)
         if "ab_pairs" in e:
             # these entries switched measurement method (best-of ->
             # alternating-pairs median) in round 6; a distinct hash makes
@@ -570,7 +581,8 @@ def _metrics_snapshot(result) -> dict:
     snap = {k: v for k, v in m.items()
             if k.startswith(("time/", "spill/", "demote/", "checkpoint/",
                              "shuffle/", "engine/", "mem/", "pipeline/",
-                             "feed_block_ms/"))}
+                             "feed_block_ms/", "compile/", "xprof/",
+                             "device/", "hbm/"))}
     return snap
 
 

@@ -146,6 +146,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "done, ETA, phase) for long streamed jobs")
     p.add_argument("--progress-interval", type=float, default=10.0,
                    help="minimum seconds between --progress lines")
+    p.add_argument("--hbm-sample-interval", type=float, default=0.0,
+                   help="live HBM sampler: seconds between background "
+                        "device.memory_stats() reads (hbm/live_bytes "
+                        "watermark gauges, heartbeat hbm= field, crash "
+                        "bundles); 0 = off")
+    p.add_argument("--stall-factor", type=float, default=0.0,
+                   help="stall detector: warn with the open span names "
+                        "when no chunk completes within this multiple of "
+                        "the median chunk time; 0 = off")
     p.add_argument("--keep-intermediates", action="store_true")
     p.add_argument("-v", "--verbose", action="store_true")
     p.add_argument("-q", "--quiet", action="store_true")
@@ -183,6 +192,8 @@ def config_from_args(args: argparse.Namespace) -> JobConfig:
         crash_dir=args.crash_dir,
         progress=args.progress,
         progress_interval_s=args.progress_interval,
+        hbm_sample_s=args.hbm_sample_interval,
+        stall_warn_factor=args.stall_factor,
         rescan_full=args.rescan_full,
         collect_max_rows=args.collect_max_rows,
         hll_precision=args.hll_precision,

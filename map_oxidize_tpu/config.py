@@ -103,6 +103,15 @@ class JobConfig:
     progress: bool = False
     #: minimum seconds between progress lines
     progress_interval_s: float = 10.0
+    #: live HBM sampler: seconds between ``device.memory_stats()`` reads
+    #: on a background thread (``hbm/live_bytes_device<i>`` watermark
+    #: gauges, heartbeat hbm= field, crash-bundle evidence).  0 disables
+    #: (the default: phase-boundary sampling still runs)
+    hbm_sample_s: float = 0.0
+    #: stall detector: warn when no chunk completes within this multiple
+    #: of the median inter-chunk interval, naming the open spans.  0
+    #: disables (the default — tests and short jobs stay silent)
+    stall_warn_factor: float = 0.0
     #: multi-host: coordination-service address ("host:port"); empty = the
     #: single-process path.  With it set, dist_num_processes and
     #: dist_process_id select this process's slot; jax.distributed is
@@ -183,6 +192,10 @@ class JobConfig:
             raise ValueError("collect_max_rows must be >= 0 (0 = default)")
         if self.progress_interval_s <= 0:
             raise ValueError("progress_interval_s must be positive")
+        if self.hbm_sample_s < 0:
+            raise ValueError("hbm_sample_s must be >= 0 (0 = off)")
+        if self.stall_warn_factor < 0:
+            raise ValueError("stall_warn_factor must be >= 0 (0 = off)")
         from map_oxidize_tpu.workloads.distinct import HLL_P_MIN, HLL_P_MAX
 
         if not HLL_P_MIN <= self.hll_precision <= HLL_P_MAX:

@@ -87,6 +87,12 @@ class Obs:
     #: runs; 0/1 for the single-controller drivers)
     process: int = 0
     n_processes: int = 1
+    #: live device sampler (HBM watermarks + stall detector), when the
+    #: config asked for either; stopped by finish/flight
+    sampler: "object | None" = None
+    #: compile-ledger snapshot taken at job start — finish deltas the
+    #: process-global ledger against it for per-job xprof numbers
+    xprof_base: "dict | None" = None
 
     @classmethod
     def from_config(cls, config, process: int = 0,
@@ -123,8 +129,23 @@ class Obs:
                 hb = Heartbeat(total_bytes=total,
                                interval_s=config.progress_interval_s,
                                emit=emit)
-        return cls(registry=MetricsRegistry(), tracer=tracer, heartbeat=hb,
-                   process=process, n_processes=n_processes)
+        obs = cls(registry=MetricsRegistry(), tracer=tracer, heartbeat=hb,
+                  process=process, n_processes=n_processes)
+        # the XLA program observatory is always-on: compile counts, costs
+        # and dispatch gaps accrue in the process-global ledger; the job
+        # deltas against this baseline at finish (obs/compile.py)
+        from map_oxidize_tpu.obs import compile as _compile
+
+        obs.xprof_base = _compile.LEDGER.activate(obs)
+        hbm_s = getattr(config, "hbm_sample_s", 0.0)
+        stall = getattr(config, "stall_warn_factor", 0.0)
+        if hbm_s > 0 or stall > 0:
+            from map_oxidize_tpu.obs.xprof import DeviceSampler
+
+            obs.sampler = DeviceSampler(obs, interval_s=hbm_s,
+                                        stall_factor=stall)
+            obs.sampler.start()
+        return obs
 
     @contextlib.contextmanager
     def phase(self, name: str, **attrs):
@@ -163,20 +184,47 @@ class Obs:
             "wall_start_unix_s": round(self.tracer.wall_start, 6),
         }
 
+    def finish_xprof(self) -> dict | None:
+        """Close the job's XLA observatory window: stop the sampler,
+        release the compile-ledger hookup, and fold the per-job delta
+        (compile counts, per-program MFU, bound classification) into the
+        registry as flat ``compile/*`` / ``xprof/*`` gauges — the fields
+        the run ledger and ``obs diff --gate`` compare.  Returns the
+        structured report for the metrics document (None on a second
+        call or when the observatory never opened)."""
+        from map_oxidize_tpu.obs import compile as _compile
+        from map_oxidize_tpu.obs import xprof
+
+        if self.sampler is not None:
+            self.sampler.stop()
+            self.sampler = None
+        _compile.LEDGER.deactivate(self)
+        base, self.xprof_base = self.xprof_base, None
+        if base is None:
+            return None
+        report = xprof.job_report(_compile.LEDGER.job_delta(base))
+        for k, v in xprof.flatten_report(report).items():
+            self.registry.set(k, v)
+        return report
+
     def finish(self, config, workload: str | None = None
                ) -> tuple[dict, list | None]:
-        """End-of-job hook: final memory watermarks, flag-driven file
-        exports (version/config-hash stamped), the optional ledger
-        append, and the ``(summary, trace_events)`` pair the result
-        carries.  ``trace_events`` is None when tracing was off."""
+        """End-of-job hook: final memory watermarks, the xprof export,
+        flag-driven file exports (version/config-hash stamped), the
+        optional ledger append, and the ``(summary, trace_events)`` pair
+        the result carries.  ``trace_events`` is None when tracing was
+        off."""
+        xprof_report = self.finish_xprof()
         sample_host_memory(self.registry)
         sample_device_memory(self.registry)
         if self.heartbeat is not None:
             self.heartbeat.final_beat()
         meta = self.stamp(config, workload)
         if config.metrics_out:
-            write_json_atomic(config.metrics_out,
-                              dict(self.registry.to_dict(), meta=meta))
+            doc = dict(self.registry.to_dict(), meta=meta)
+            if xprof_report is not None:
+                doc["xprof"] = xprof_report
+            write_json_atomic(config.metrics_out, doc)
         trace = self.tracer.chrome_trace() if self.tracer.enabled else None
         if trace is not None:
             trace.insert(0, {"name": "moxt_meta", "ph": "M",

@@ -1,6 +1,6 @@
 """``python -m map_oxidize_tpu obs ...`` — observability artifact tools.
 
-Two subcommands, both pure host-side file work (no jax, no backend
+Three subcommands, all pure host-side file work (no jax, no backend
 init):
 
 * ``obs merge`` — combine a distributed run's per-process trace shards
@@ -13,6 +13,10 @@ init):
   deltas, identity-checked (workload, config hash, version) so
   apples-to-oranges comparisons refuse by default; ``--gate`` exits
   nonzero when a regression exceeds the threshold.
+* ``obs xprof`` — render the XLA program observatory report from a run's
+  ``--metrics-out`` document (or an obs shard): per-program compile
+  counts with recompile causes, FLOPs/bytes from ``cost_analysis``,
+  achieved-vs-peak utilization, and the dispatch-gap histogram summary.
 """
 
 from __future__ import annotations
@@ -57,6 +61,16 @@ def build_obs_parser() -> argparse.ArgumentParser:
     d.add_argument("--force", action="store_true",
                    help="diff even when workload/config-hash/version "
                         "differ (mismatches print as warnings)")
+
+    x = sub.add_parser(
+        "xprof", help="render the XLA program observatory report (compile "
+                      "ledger, cost/MFU join, dispatch-gap histograms) "
+                      "from a --metrics-out document")
+    x.add_argument("metrics", help="a run's --metrics-out JSON (or a "
+                                   "<metrics_out>.proc<i> shard document)")
+    x.add_argument("--json", action="store_true",
+                   help="emit the structured report as JSON instead of "
+                        "the rendered tables")
     return p
 
 
@@ -64,7 +78,36 @@ def obs_main(argv: list[str]) -> int:
     args = build_obs_parser().parse_args(argv)
     if args.cmd == "merge":
         return _merge(args)
+    if args.cmd == "xprof":
+        return _xprof(args)
     return _diff(args)
+
+
+def _xprof(args) -> int:
+    import json
+
+    from map_oxidize_tpu.obs.xprof import render_report
+
+    try:
+        with open(args.metrics) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"error: cannot read metrics document {args.metrics!r}: {e}",
+              file=sys.stderr)
+        return 2
+    if doc.get("schema"):  # an obs shard: the metrics doc nests inside
+        doc = doc.get("metrics", {})
+    report = doc.get("xprof")
+    if not report:
+        print("error: no xprof section in this metrics document (produced "
+              "by a pre-observatory version, or the job ran no jitted "
+              "programs)", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(report, indent=1, sort_keys=True))
+        return 0
+    print(render_report(report, histograms=doc.get("histograms")))
+    return 0
 
 
 def _merge(args) -> int:

@@ -1,0 +1,373 @@
+"""Compile ledger: observe every jitted program the framework owns.
+
+Round 5's decomposition found that *dispatch cost* — not link bandwidth —
+is the binding constraint on streamed k-means, and the finding required a
+hand-run characterization script because nothing in the obs stack could
+see compiles, recompiles, per-program cost, or per-dispatch overhead.
+DrJAX (arXiv:2403.07128) makes the same point structurally: MapReduce-in-
+JAX performance lives or dies on keeping the per-round program count and
+recompile rate flat.  This module is the always-on accounting for it:
+
+* :func:`observed_jit` wraps a jitted callable under a stable *program
+  name*.  Each call is timed (the **dispatch gap**: host handoff ->
+  async return — the ~150-250 ms/launch floor measured through the
+  remote-attach tunnel) and compiles are detected via the jit cache size
+  growing across the call.  A program compiling more than once gets a
+  named **recompile cause** (new input shape / new dtype / new static
+  config / retrace) derived by diffing the new signature against the
+  seen set.
+* At compile time the wrapper captures ``Lowered.cost_analysis()``
+  (FLOPs, bytes accessed — no backend compile needed), which
+  :mod:`map_oxidize_tpu.obs.xprof` later joins with per-dispatch timing
+  into achieved FLOP/s / bytes/s and an MFU figure per program.
+* Backend-compile wall time is attributed precisely through a
+  ``jax.monitoring`` duration listener scoped by a thread-local
+  current-program marker (falling back to the compiling call's wall).
+
+The ledger is process-global (jit executable caches are process-global);
+jobs see per-job numbers by snapshotting at ``Obs`` creation and
+exporting the delta at finish (:meth:`CompileLedger.export_job`).
+Overhead per observed dispatch is two ``perf_counter`` reads and a dict
+probe; the sampled device-compute read (``block_until_ready`` every
+``sample_every``-th dispatch per program) is the only sync added.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from map_oxidize_tpu.utils.logging import get_logger
+
+_log = get_logger(__name__)
+
+#: sample the post-return device-compute wait on the 1st and then every
+#: N-th dispatch of each program (bounded sync cost on async pipelines)
+SAMPLE_EVERY = 16
+
+
+class ProgramStats:
+    """Cumulative per-program record (keyed by program *name*, so fresh
+    per-job jit closures of the same program aggregate)."""
+
+    __slots__ = ("name", "compiles", "compile_ms", "backend_compile_ms",
+                 "dispatches", "dispatch_ms", "sampled_ms", "samples",
+                 "causes", "sigs", "flops", "bytes_accessed")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.compiles = 0
+        self.compile_ms = 0.0          # wall of the compiling calls
+        self.backend_compile_ms = 0.0  # attributed XLA backend time
+        self.dispatches = 0
+        self.dispatch_ms = 0.0         # host handoff -> async return
+        self.sampled_ms = 0.0          # sampled post-return ready waits
+        self.samples = 0
+        self.causes: list[str] = []
+        #: signature -> (flops, bytes) cost from Lowered.cost_analysis
+        self.sigs: dict = {}
+        # latest known per-dispatch cost (None = analysis unavailable)
+        self.flops: float | None = None
+        self.bytes_accessed: float | None = None
+
+    def snapshot(self) -> tuple:
+        return (self.compiles, self.compile_ms, self.backend_compile_ms,
+                self.dispatches, self.dispatch_ms, self.sampled_ms,
+                self.samples, len(self.causes))
+
+
+class CompileLedger:
+    """Process-global registry of observed programs plus the active job's
+    :class:`~map_oxidize_tpu.obs.Obs` hookup (histograms + heartbeat
+    warnings go to whichever job is currently recording)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.programs: dict[str, ProgramStats] = {}
+        self._active = None       # the recording job's Obs (or None)
+        self._active_base: dict = {}  # its activation snapshot
+        self._tls = threading.local()
+        self._listener_on = False
+
+    # --- job lifecycle ----------------------------------------------------
+
+    def activate(self, obs) -> dict:
+        """Mark ``obs`` as the recording job; returns the baseline
+        snapshot its finish will delta against."""
+        with self._lock:
+            self._active = obs
+            self._active_base = {n: p.snapshot()
+                                 for n, p in self.programs.items()}
+            return dict(self._active_base)
+
+    def deactivate(self, obs) -> None:
+        with self._lock:
+            if self._active is obs:
+                self._active = None
+                self._active_base = {}
+
+    # --- recording (called from ObservedJit) ------------------------------
+
+    def _stats(self, name: str) -> ProgramStats:
+        p = self.programs.get(name)
+        if p is None:
+            with self._lock:
+                p = self.programs.setdefault(name, ProgramStats(name))
+        return p
+
+    def _ensure_listener(self) -> None:
+        """Attribute XLA backend-compile durations to the program whose
+        call triggered them (thread-local marker; registration is global
+        and permanent, so it happens at most once per process)."""
+        if self._listener_on:
+            return
+        with self._lock:
+            if self._listener_on:
+                return
+            try:
+                import jax.monitoring as mon
+
+                def _on_duration(event: str, duration: float, **kw):
+                    if not event.endswith("backend_compile_duration"):
+                        return
+                    cur = getattr(self._tls, "current", None)
+                    if cur is not None:
+                        cur.backend_compile_ms += duration * 1e3
+
+                mon.register_event_duration_secs_listener(_on_duration)
+                self._listener_on = True
+            except Exception:  # monitoring API drift must not break jobs
+                self._listener_on = True
+
+    def record_compile(self, stats: ProgramStats, sig, cause: str,
+                       wall_ms: float, cost) -> None:
+        with self._lock:
+            stats.compiles += 1
+            stats.compile_ms += wall_ms
+            if cause != "first":
+                stats.causes.append(cause)
+            if sig is not None:
+                stats.sigs[sig] = cost
+            if cost is not None:
+                stats.flops, stats.bytes_accessed = cost
+        obs = self._active
+        # warn on the job's OWN recompiles only: a later job in the same
+        # process legitimately compiles programs an earlier job already
+        # ran (new static configs, new shapes) — the per-job delta the
+        # gate reads handles those; the live warning is for a program
+        # compiling twice within ONE job (a shape-set leak in flight)
+        job_compiles = stats.compiles - self._active_base.get(
+            stats.name, (0,))[0]
+        if job_compiles > 1 and obs is not None:
+            line = (f"[xprof] recompile #{job_compiles} of {stats.name} "
+                    f"this job: {cause} ({len(stats.sigs)} input-shape "
+                    "sets)")
+            if obs.heartbeat is not None:
+                obs.heartbeat._emit(line)
+            else:
+                _log.warning("%s", line)
+
+    def record_dispatch(self, stats: ProgramStats, gap_ms: float,
+                        ready_ms: float | None, compiled: bool) -> None:
+        """A compiling call's wall is compile time, not dispatch gap — it
+        is excluded from the gap histogram and the per-program dispatch
+        wall so steady-state overhead and rate estimates stay clean."""
+        with self._lock:
+            stats.dispatches += 1
+            if not compiled:
+                stats.dispatch_ms += gap_ms
+            if ready_ms is not None:
+                stats.sampled_ms += ready_ms
+                stats.samples += 1
+        obs = self._active
+        if obs is not None:
+            if not compiled:
+                obs.registry.observe("device/dispatch_gap_ms", gap_ms)
+            if ready_ms is not None:
+                obs.registry.observe("device/compute_ms", ready_ms)
+
+    # --- export -----------------------------------------------------------
+
+    def job_delta(self, baseline: dict) -> dict:
+        """Per-program activity since ``baseline`` (programs with zero
+        compiles AND zero dispatches in the window are omitted)."""
+        out = {}
+        with self._lock:
+            items = list(self.programs.items())
+        for name, p in items:
+            b = baseline.get(name, (0, 0.0, 0.0, 0, 0.0, 0.0, 0, 0))
+            compiles = p.compiles - b[0]
+            dispatches = p.dispatches - b[3]
+            if compiles <= 0 and dispatches <= 0:
+                continue
+            out[name] = {
+                "compiles": compiles,
+                "compile_ms": round(p.compile_ms - b[1], 3),
+                "backend_compile_ms": round(p.backend_compile_ms - b[2], 3),
+                "dispatches": dispatches,
+                "dispatch_ms": round(p.dispatch_ms - b[4], 3),
+                "sampled_device_ms": round(p.sampled_ms - b[5], 3),
+                "device_samples": p.samples - b[6],
+                "recompile_causes": p.causes[b[7]:],
+                "shape_sets": len(p.sigs),
+                "flops_per_dispatch": p.flops,
+                "bytes_per_dispatch": p.bytes_accessed,
+            }
+        return out
+
+
+#: the process ledger every observed program records into
+LEDGER = CompileLedger()
+
+
+def _sig_of(args, kw):
+    """Hashable signature of a call: (shape, dtype) per array leaf,
+    ``repr`` for static/python leaves.  Weak-type and sharding changes
+    are deliberately NOT keyed (the cache-size check still counts those
+    compiles; the sig only names the cause)."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves((args, kw))
+    sig = []
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is not None and dtype is not None:
+            sig.append(("t", tuple(shape), str(dtype)))
+        else:
+            sig.append(("v", repr(leaf)))
+    return tuple(sig)
+
+
+def _classify(sig, seen: dict) -> str:
+    """Name the recompile cause by diffing ``sig`` against seen ones."""
+    shapes = tuple(s[1] for s in sig if s[0] == "t")
+    dtypes = tuple(s[2] for s in sig if s[0] == "t")
+    statics = tuple(s[1] for s in sig if s[0] == "v")
+    for old in seen:
+        o_shapes = tuple(s[1] for s in old if s[0] == "t")
+        o_dtypes = tuple(s[2] for s in old if s[0] == "t")
+        o_statics = tuple(s[1] for s in old if s[0] == "v")
+        if shapes != o_shapes and dtypes == o_dtypes and statics == o_statics:
+            return "new_input_shape"
+        if shapes == o_shapes and dtypes != o_dtypes:
+            return "new_dtype"
+        if shapes == o_shapes and dtypes == o_dtypes and statics != o_statics:
+            return "new_static_config"
+    return "signature_change"
+
+
+class ObservedJit:
+    """A jitted callable under compile/dispatch observation.
+
+    Transparent: ``.lower``/attributes pass through to the wrapped jit,
+    calls made *inside* another trace (tracer arguments) bypass the
+    bookkeeping entirely, and donation semantics are untouched (the
+    signature and cost analysis are taken BEFORE the call, while donated
+    buffers are still valid).
+    """
+
+    def __init__(self, name: str, fn, tag=None, ledger: CompileLedger = None,
+                 sample_every: int = SAMPLE_EVERY):
+        self._name = name
+        self._fn = fn
+        #: extra static identity folded into the signature (e.g. the
+        #: stream step's first/last flags, which live in the closure)
+        self._tag = tag
+        self._ledger = ledger if ledger is not None else LEDGER
+        self._sample_every = sample_every
+        self._ledger._ensure_listener()
+
+    def __getattr__(self, item):
+        return getattr(self._fn, item)
+
+    def _cache_n(self) -> int | None:
+        size = getattr(self._fn, "_cache_size", None)
+        try:
+            return size() if callable(size) else None
+        except Exception:
+            return None
+
+    def __call__(self, *args, **kw):
+        import jax
+
+        if any(isinstance(leaf, jax.core.Tracer)
+               for leaf in jax.tree_util.tree_leaves((args, kw))):
+            # called inside another program's trace: it inlines there and
+            # is that outer program's cost, not a dispatch of this one
+            return self._fn(*args, **kw)
+        led = self._ledger
+        stats = led._stats(self._name)
+        sig = _sig_of(args, kw)
+        if self._tag is not None:
+            sig = sig + (("v", repr(self._tag)),)
+        cost = None
+        # the seen-set is ledger-level (keyed by program NAME): a fresh
+        # per-job jit closure of the same program re-compiling the same
+        # signature classifies as a retrace, not a new shape
+        new_sig = sig not in stats.sigs
+        if new_sig:
+            # cost analysis from the lowering — BEFORE the call, so
+            # donated operands are still live; no backend compile happens
+            try:
+                ca = self._fn.lower(*args, **kw).cost_analysis()
+                if isinstance(ca, (list, tuple)):
+                    ca = ca[0] if ca else {}
+                if isinstance(ca, dict):
+                    fl = float(ca.get("flops", -1.0))
+                    by = float(ca.get("bytes accessed", -1.0))
+                    cost = (fl if fl > 0 else None, by if by > 0 else None)
+            except Exception:
+                cost = None
+        before = self._cache_n()
+        tls = led._tls
+        prev_cur = getattr(tls, "current", None)
+        tls.current = stats
+        t0 = time.perf_counter()
+        try:
+            out = self._fn(*args, **kw)
+        finally:
+            tls.current = prev_cur
+        gap_ms = (time.perf_counter() - t0) * 1e3
+        after = self._cache_n()
+        compiled = (after > before if (before is not None
+                                       and after is not None) else new_sig)
+        if compiled:
+            cause = ("first" if not stats.sigs
+                     else _classify(sig, stats.sigs)
+                     if new_sig else "retrace_same_signature")
+            led.record_compile(stats, sig if new_sig else None, cause,
+                               gap_ms, cost)
+        elif new_sig:
+            # the signature is new to the ledger but this jit already had
+            # it cached (a pre-activation warm call): remember it so cost
+            # joins and later cause classification stay complete
+            with led._lock:
+                stats.sigs.setdefault(sig, cost)
+                if cost is not None and stats.flops is None:
+                    stats.flops, stats.bytes_accessed = cost
+        ready_ms = None
+        # sample on the JOB-relative dispatch ordinal (delta from the
+        # activation baseline), not the process-lifetime one: the first
+        # dispatch of every job is always sampled, so the MFU join never
+        # silently flips between the sampled-ready-wait and
+        # dispatch-wall estimators across the runs a gate compares
+        base = led._active_base.get(self._name)
+        n = stats.dispatches - (base[3] if base else 0) + 1
+        if n <= 1 or n % self._sample_every == 0 or compiled:
+            t1 = time.perf_counter()
+            try:
+                jax.block_until_ready(out)
+                ready_ms = (time.perf_counter() - t1) * 1e3
+            except Exception:
+                ready_ms = None
+        led.record_dispatch(stats, gap_ms, ready_ms, compiled)
+        return out
+
+
+def observed_jit(name: str, fn, tag=None) -> ObservedJit:
+    """Observe an already-jitted callable under a stable program name.
+    The name is the join key for everything downstream — compile counts,
+    recompile causes, cost/MFU rows, the ``obs xprof`` table, and the
+    ledger gate — so it must be stable across runs (no per-job salt)."""
+    return ObservedJit(name, fn, tag=tag)

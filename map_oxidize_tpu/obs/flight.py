@@ -65,12 +65,19 @@ def _record(obs, config, exc, workload):
 
     err = f"{type(exc).__name__}: {exc}"
     obs.tracer.close_open_spans(error=err)
+    # the xprof window closes here too: the sampler takes a final HBM
+    # reading before stopping, and the compile/dispatch accounting as of
+    # the crash lands in the bundle (an abort mid-recompile-storm is
+    # exactly when the compile ledger matters)
+    xprof_report = obs.finish_xprof()
     sample_host_memory(obs.registry)
     sample_device_memory(obs.registry)
     obs.registry.set("aborted", True)
 
     meta = obs.stamp(config, workload)
     metrics_doc = dict(obs.registry.to_dict(), meta=meta)
+    if xprof_report is not None:
+        metrics_doc["xprof"] = xprof_report
     trace = obs.tracer.chrome_trace() if obs.tracer.enabled else None
     if trace is not None:
         trace.insert(0, {"name": "moxt_meta", "ph": "M",

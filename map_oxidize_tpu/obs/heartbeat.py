@@ -46,6 +46,9 @@ class Heartbeat:
         self.bytes_done = 0
         self.fraction: float | None = None
         self.beats = 0
+        #: live HBM in use (max over devices), fed by the obs device
+        #: sampler thread when one is running; None keeps it off the line
+        self.hbm_bytes: int | None = None
 
     def set_phase(self, name: str) -> None:
         self.phase = name
@@ -94,6 +97,8 @@ class Heartbeat:
             if 0 < frac < 1:
                 eta = elapsed * (1 - frac) / frac
                 parts.append(f"eta={_fmt_eta(eta)}")
+        if self.hbm_bytes is not None:
+            parts.append(f"hbm={self.hbm_bytes / (1 << 30):.2f}GB")
         self._emit(" ".join(parts))
 
 

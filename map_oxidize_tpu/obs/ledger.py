@@ -37,6 +37,7 @@ _NON_IDENTITY_FIELDS = frozenset({
     "input_path", "output_path", "checkpoint_dir", "keep_intermediates",
     "trace_dir", "trace_out", "metrics_out", "metrics", "progress",
     "progress_interval_s", "ledger_dir", "crash_dir",
+    "hbm_sample_s", "stall_warn_factor",
     "dist_coordinator", "dist_process_id",
 })
 
@@ -187,6 +188,24 @@ def diff_entries(a: dict, b: dict, threshold_pct: float = 10.0,
             if pct is not None and pct < -threshold_pct:
                 regressions.append(
                     f"{name}: {va:,.1f} -> {vb:,.1f} ({pct:.1f}%)")
+        elif name.startswith("compile/") and name.endswith(
+                ("/compiles", "total_compiles")):
+            # XLA-layer gate: a silent recompile is a regression at ANY
+            # threshold — each extra compile is tens of seconds through
+            # the tunnel and signals an input-shape-set leak (DrJAX's
+            # flat-program-count invariant)
+            if va != vb:
+                rows.append((name, va, vb, pct))
+            if (isinstance(va, (int, float)) and isinstance(vb, (int, float))
+                    and vb > va):
+                regressions.append(
+                    f"{name}: {va:g} -> {vb:g} compiles (recompile "
+                    "regression)")
+        elif name.startswith("xprof/") and name.endswith("/mfu_pct"):
+            rows.append((name, va, vb, pct))
+            if pct is not None and pct < -threshold_pct:
+                regressions.append(
+                    f"{name}: {va:.2f}% -> {vb:.2f}% ({pct:.1f}%)")
         elif va != vb:
             rows.append((name, va, vb, pct))
     return {"rows": rows, "regressions": regressions, "warnings": warnings}

@@ -1,0 +1,350 @@
+"""XLA program observatory: cost/roofline join, report rendering, and the
+live device sampler.
+
+The join half turns :mod:`map_oxidize_tpu.obs.compile`'s raw per-program
+record (compiles, causes, FLOPs/bytes from ``cost_analysis``, dispatch
+timings) into the per-job accounting the ISSUE's Exoshuffle argument
+demands — *where the FLOPs and bytes actually go*:
+
+* achieved FLOP/s and bytes/s per program, from cost-analysis cost x
+  dispatch count over the estimated device time (the sampled
+  ``block_until_ready`` waits when available, else the dispatch walls);
+* MFU against the session-measured peak (bench's matmul probe, exported
+  via ``MOXT_PEAK_FLOPS``; defaults to the round-5 sustained
+  measurement on TPU) and achieved-bandwidth fraction against
+  ``MOXT_PEAK_MEMBW``;
+* a memory-bound / compute-bound classification from arithmetic
+  intensity vs the machine balance point.
+
+The sampler half is one low-rate daemon thread per job doing two things
+the inline instrumentation cannot:
+
+* **live HBM watermarks** — ``hbm/live_bytes_device<i>`` gauges sampled
+  from ``device.memory_stats()`` between phase boundaries (the existing
+  end-of-phase samples miss mid-phase peaks), surfaced on heartbeat
+  lines and in flight-recorder crash bundles;
+* **stall detection** — if no chunk completes within a configurable
+  multiple of the median inter-chunk interval, one ``[stalled]`` line
+  names the currently open span stacks (exactly what a hung feed loop
+  or a wedged collective looks like from the outside).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+from map_oxidize_tpu.utils.logging import get_logger
+
+_log = get_logger(__name__)
+
+#: fallback peaks when no env override and no probe ran: the round-5
+#: session measurements for the deployed part (bf16-sustained matmul
+#: ~91 TFLOP/s — about half the v5e nominal 197e12 — and ~60 GB/s
+#: achieved HBM read; benchmarks/RESULTS.md).  CPU hosts get no default:
+#: MFU is meaningless there, so it is simply omitted.
+TPU_PEAK_FLOPS = 91e12
+TPU_PEAK_MEMBW = 60e9
+
+#: machine-balance fallback (FLOPs per byte) for the bound classification
+#: when no peak pair is known — the TPU ratio above, rounded
+DEFAULT_BALANCE = 1500.0
+
+
+def device_peaks() -> dict:
+    """The peak rates MFU is quoted against.  ``MOXT_PEAK_FLOPS`` /
+    ``MOXT_PEAK_MEMBW`` env overrides win PER FIELD (bench exports only
+    its measured matmul peak — the membw default must survive that);
+    whatever the env leaves unset falls back to the round-5 measured
+    sustained rates on TPU, and to nothing on CPU."""
+    peaks = {"flops": None, "membw": None, "source": "none"}
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            if jax.devices()[0].platform == "tpu":
+                peaks.update(flops=TPU_PEAK_FLOPS, membw=TPU_PEAK_MEMBW,
+                             source="round5-measured-default")
+        except Exception:
+            pass
+    env_used = False
+    for env, key in (("MOXT_PEAK_FLOPS", "flops"),
+                     ("MOXT_PEAK_MEMBW", "membw")):
+        v = os.environ.get(env)
+        if v:
+            try:
+                peaks[key] = float(v)
+                env_used = True
+            except ValueError:
+                pass
+    if env_used:
+        peaks["source"] = ("env" if peaks["source"] == "none"
+                           else f"env+{peaks['source']}")
+    return peaks
+
+
+def job_report(delta: dict) -> dict:
+    """Join one job's compile-ledger delta (``CompileLedger.job_delta``)
+    with the session peaks into the per-program observatory rows the
+    metrics document carries (``metrics.json["xprof"]``)."""
+    peaks = device_peaks()
+    balance = (peaks["flops"] / peaks["membw"]
+               if peaks["flops"] and peaks["membw"] else DEFAULT_BALANCE)
+    programs = {}
+    for name, d in sorted(delta.items()):
+        row = dict(d)
+        n = d["dispatches"]
+        flops = d.get("flops_per_dispatch")
+        bytes_ = d.get("bytes_per_dispatch")
+        # device-time estimate: mean sampled ready-wait x dispatches when
+        # samples exist (the honest figure under async dispatch), else
+        # the summed dispatch walls (an upper bound: host overhead rides
+        # along, so rates and MFU read conservative)
+        dev_s = None
+        if d["device_samples"] > 0 and d["sampled_device_ms"] > 0:
+            dev_s = (d["sampled_device_ms"] / d["device_samples"]) * n / 1e3
+            row["device_time_source"] = "sampled_ready_wait"
+        elif d["dispatch_ms"] > 0:
+            dev_s = d["dispatch_ms"] / 1e3
+            row["device_time_source"] = "dispatch_wall"
+        row["device_s_est"] = round(dev_s, 6) if dev_s else None
+        if n and flops and dev_s:
+            row["achieved_flops_per_s"] = round(flops * n / dev_s, 1)
+            if peaks["flops"]:
+                row["mfu_pct"] = round(
+                    100.0 * flops * n / dev_s / peaks["flops"], 3)
+        if n and bytes_ and dev_s:
+            row["achieved_bytes_per_s"] = round(bytes_ * n / dev_s, 1)
+            if peaks["membw"]:
+                row["membw_pct"] = round(
+                    100.0 * bytes_ * n / dev_s / peaks["membw"], 3)
+        if flops and bytes_:
+            intensity = flops / bytes_
+            row["intensity_flops_per_byte"] = round(intensity, 4)
+            row["bound"] = ("compute" if intensity >= balance else "memory")
+        programs[name] = row
+    return {
+        "programs": programs,
+        "peaks": peaks,
+        "balance_flops_per_byte": round(balance, 2),
+        "total_compiles": sum(d["compiles"] for d in delta.values()),
+        "total_compile_ms": round(
+            sum(d["compile_ms"] for d in delta.values()), 3),
+        "total_dispatches": sum(d["dispatches"] for d in delta.values()),
+    }
+
+
+def flatten_report(report: dict) -> dict:
+    """The scalar projection of :func:`job_report` for the flat metrics
+    summary — what rides ``JobResult.metrics``, the run ledger, and the
+    ``obs diff --gate`` / ``bench.py --gate`` regression checks."""
+    out = {
+        "compile/total_compiles": report["total_compiles"],
+        "compile/total_ms": report["total_compile_ms"],
+    }
+    for name, row in report["programs"].items():
+        out[f"compile/{name}/compiles"] = row["compiles"]
+        out[f"compile/{name}/shape_sets"] = row["shape_sets"]
+        if row["recompile_causes"]:
+            out[f"compile/{name}/recompile_cause"] = \
+                row["recompile_causes"][-1]
+        out[f"xprof/{name}/dispatches"] = row["dispatches"]
+        for k, dst in (("mfu_pct", "mfu_pct"), ("membw_pct", "membw_pct"),
+                       ("bound", "bound")):
+            if row.get(k) is not None:
+                out[f"xprof/{name}/{dst}"] = row[k]
+    return out
+
+
+# --- report rendering (the `obs xprof` table) ------------------------------
+
+
+def _fmt_rate(v, unit):
+    if v is None:
+        return "-"
+    for scale, suffix in ((1e12, "T"), (1e9, "G"), (1e6, "M"), (1e3, "K")):
+        if v >= scale:
+            return f"{v / scale:.2f} {suffix}{unit}"
+    return f"{v:.1f} {unit}"
+
+
+def render_report(report: dict, histograms: dict | None = None) -> str:
+    """Human-readable observatory report: the compile table, the
+    cost/utilization table, and the dispatch-gap histogram summary."""
+    lines = ["XLA program observatory"]
+    peaks = report.get("peaks", {})
+    lines.append(
+        f"  peaks: flops={_fmt_rate(peaks.get('flops'), 'FLOP/s')} "
+        f"membw={_fmt_rate(peaks.get('membw'), 'B/s')} "
+        f"({peaks.get('source', '?')}); balance "
+        f"{report.get('balance_flops_per_byte')} FLOP/byte")
+    progs = report.get("programs", {})
+    if not progs:
+        lines.append("  (no observed programs ran in this job)")
+        return "\n".join(lines)
+    lines.append(
+        f"  {report['total_compiles']} compiles "
+        f"({report['total_compile_ms']:.1f} ms) across {len(progs)} "
+        f"programs, {report['total_dispatches']} dispatches")
+    lines.append("compiles:")
+    lines.append(f"  {'program':<28} {'n':>3} {'ms':>9} {'shapes':>6}  cause")
+    for name, r in progs.items():
+        cause = ", ".join(r["recompile_causes"]) if r["recompile_causes"] \
+            else "-"
+        lines.append(f"  {name:<28} {r['compiles']:>3} "
+                     f"{r['compile_ms']:>9.1f} {r['shape_sets']:>6}  {cause}")
+    lines.append("cost / utilization:")
+    lines.append(f"  {'program':<28} {'disp':>5} {'flops/disp':>11} "
+                 f"{'bytes/disp':>11} {'achieved':>12} {'MFU%':>6} "
+                 f"{'bw%':>6}  bound")
+    for name, r in progs.items():
+        lines.append(
+            f"  {name:<28} {r['dispatches']:>5} "
+            f"{_fmt_rate(r.get('flops_per_dispatch'), ''):>11} "
+            f"{_fmt_rate(r.get('bytes_per_dispatch'), ''):>11} "
+            f"{_fmt_rate(r.get('achieved_flops_per_s'), 'F/s'):>12} "
+            f"{r.get('mfu_pct', '-'):>6} {r.get('membw_pct', '-'):>6}  "
+            f"{r.get('bound', '-')}")
+    if histograms:
+        for h in ("device/dispatch_gap_ms", "device/compute_ms"):
+            s = histograms.get(h)
+            if s:
+                lines.append(
+                    f"{h}: n={s.get('count')} p50={s.get('p50')} "
+                    f"p95={s.get('p95')} max={s.get('max')} "
+                    f"mean={s.get('mean')}")
+    return "\n".join(lines)
+
+
+# --- live device sampler ---------------------------------------------------
+
+
+class DeviceSampler:
+    """Low-rate daemon thread: live HBM watermarks + the stall detector.
+
+    Chunk progress is read from the job's own registry (the
+    ``feed_block_ms`` / ``device/dispatch_gap_ms`` histogram counts and
+    the ``engine/flushes`` / ``pipeline/chunks`` counters), so the
+    detector needs no extra hooks in the drivers and works with or
+    without ``--progress``.  Stall warnings fire once per episode (a
+    completing chunk re-arms the detector).
+    """
+
+    #: registry series whose growth means "a chunk completed"
+    PROGRESS_HISTS = ("feed_block_ms", "device/dispatch_gap_ms")
+    PROGRESS_COUNTERS = ("engine/flushes", "pipeline/chunks")
+
+    def __init__(self, obs, interval_s: float = 0.0,
+                 stall_factor: float = 0.0):
+        self.obs = obs
+        self.interval_s = interval_s if interval_s > 0 else 0.5
+        self.stall_factor = stall_factor
+        self.sample_hbm = interval_s > 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="obs-device-sampler")
+        self._intervals: list[float] = []
+        self._last_signal = 0
+        self._last_change = time.monotonic()
+        self._warned = False
+        self.stall_warnings = 0
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        # final watermark read so short jobs still record one sample
+        if self.sample_hbm:
+            self.sample_once()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            if self.sample_hbm:
+                self.sample_once()
+            if self.stall_factor > 0:
+                self.check_stall()
+
+    # --- HBM --------------------------------------------------------------
+
+    def sample_once(self) -> None:
+        """One live-bytes reading per initialized device.  A no-op until
+        the job itself has imported jax (never init a backend from the
+        sampler) and on backends without memory stats (CPU)."""
+        jax = sys.modules.get("jax")
+        if jax is None:
+            return
+        try:
+            devices = jax.devices()
+        except Exception:
+            return
+        best = None
+        for d in devices:
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                stats = None
+            if not stats:
+                continue
+            in_use = stats.get("bytes_in_use")
+            if in_use is None:
+                continue
+            self.obs.registry.gauge_max(f"hbm/live_bytes_device{d.id}",
+                                        int(in_use))
+            best = max(best or 0, int(in_use))
+        if best is not None and self.obs.heartbeat is not None:
+            self.obs.heartbeat.hbm_bytes = best
+
+    # --- stall detection --------------------------------------------------
+
+    def _progress_signal(self) -> int:
+        reg = self.obs.registry
+        with reg._lock:
+            n = sum(reg.histograms[h].count for h in self.PROGRESS_HISTS
+                    if h in reg.histograms)
+            n += sum(int(reg.counters.get(c, 0))
+                     for c in self.PROGRESS_COUNTERS)
+        return n
+
+    def check_stall(self, now: float | None = None) -> bool:
+        """One detector tick (public for the fake-clock tests).  Returns
+        True when a stall warning was emitted this tick."""
+        now = time.monotonic() if now is None else now
+        sig = self._progress_signal()
+        if sig != self._last_signal:
+            if self._last_signal:
+                self._intervals.append(now - self._last_change)
+                if len(self._intervals) > 64:
+                    del self._intervals[0]
+            self._last_signal = sig
+            self._last_change = now
+            self._warned = False
+            return False
+        if self._warned or len(self._intervals) < 3:
+            return False
+        med = sorted(self._intervals)[len(self._intervals) // 2]
+        elapsed = now - self._last_change
+        if med <= 0 or elapsed < self.stall_factor * med:
+            return False
+        self._warned = True
+        self.stall_warnings += 1
+        tracer = self.obs.tracer
+        spans = []
+        if tracer.enabled:
+            with tracer._lock:
+                for _tid, stack in tracer._stacks:
+                    if stack:
+                        spans.append(" > ".join(s.name for s in stack))
+        open_s = "; ".join(spans) if spans else "(no trace: run with " \
+                                                "--trace-out for span names)"
+        line = (f"[stalled] no chunk completed in {elapsed:.1f}s "
+                f"(median {med:.2f}s, factor {self.stall_factor:g}); "
+                f"open spans: {open_s}")
+        hb = self.obs.heartbeat
+        if hb is not None:
+            hb._emit(line)
+        else:
+            _log.warning("%s", line)
+        self.obs.registry.count("stall_warnings")
+        return True

@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from map_oxidize_tpu.obs.compile import observed_jit
 from map_oxidize_tpu.ops.hashing import SENTINEL
 
 #: polynomial multipliers: odd (invertible mod 2^32), independent; P1 is the
@@ -232,6 +233,7 @@ def tokenize_count_core(chunk, pk1, pki1, pk2, pki2,
     return u_hi, u_lo, counts, reps, packed
 
 
+@partial(observed_jit, "device_map/tokenize")
 @partial(jax.jit,
          static_argnames=("max_tokens", "out_keys", "fetch_keys", "ngram"))
 def tokenize_count_chunk(chunk, pk1, pki1, pk2, pki2,

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from map_oxidize_tpu.obs.compile import observed_jit
 from map_oxidize_tpu.ops.hashing import SENTINEL
 
 def _identity(combine: str, dtype) -> np.ndarray:
@@ -134,6 +135,7 @@ def make_accumulator(capacity: int, val_shape=(), val_dtype=jnp.int32,
     return hi, lo, vals
 
 
+@partial(observed_jit, "engine/merge_packed")
 @partial(jax.jit, static_argnames=("combine",), donate_argnums=(0, 1, 2, 3, 4))
 def merge_packed_into_accumulator(acc_hi, acc_lo, acc_vals, ovf, packed,
                                   combine="sum"):
@@ -148,6 +150,7 @@ def merge_packed_into_accumulator(acc_hi, acc_lo, acc_vals, ovf, packed,
                                   b_hi, b_lo, b_vals, combine=combine)
 
 
+@partial(observed_jit, "engine/pack_finalize")
 @jax.jit
 def pack_accumulator_state(acc_hi, acc_lo, acc_vals, n_unique, ovf):
     """Bundle everything finalize needs into ONE ``(3, cap+1)`` uint32 array:
@@ -162,6 +165,7 @@ def pack_accumulator_state(acc_hi, acc_lo, acc_vals, n_unique, ovf):
     return jnp.concatenate([head, extra[:, None]], axis=1)
 
 
+@partial(observed_jit, "engine/merge")
 @partial(jax.jit, static_argnames=("combine",), donate_argnums=(0, 1, 2, 3))
 def merge_into_accumulator(acc_hi, acc_lo, acc_vals, ovf, b_hi, b_lo, b_vals,
                            combine="sum"):

@@ -51,8 +51,13 @@ def top_k_pairs(hi, lo, counts, k: int):
     return jnp.take(hi, top_idx), jnp.take(lo, top_idx), top_vals
 
 
-#: cached-compile variant for repeated host-driven calls
-top_k_pairs_jit = jax.jit(top_k_pairs, static_argnames="k")
+#: cached-compile variant for repeated host-driven calls, observed by the
+#: compile ledger (a top-k recompile means the accumulator capacity or k
+#: drifted between calls)
+from map_oxidize_tpu.obs.compile import observed_jit  # noqa: E402
+
+top_k_pairs_jit = observed_jit("engine/top_k",
+                               jax.jit(top_k_pairs, static_argnames="k"))
 
 
 def top_k_candidate_indices(vals, k: int):

@@ -109,11 +109,14 @@ class ShardedCollectEngine:
                                 (bdl, s_dl))]
             return (*out, (c + live)[None], ovf)
 
-        self._route_append = jax.jit(shard_map(
-            _route_append, mesh=self.mesh,
-            in_specs=(row2,) * 4 + (spec,) * 5,
-            out_specs=(row2,) * 4 + (spec, P()),
-        ), donate_argnums=(0, 1, 2, 3, 4))
+        from map_oxidize_tpu.obs.compile import observed_jit
+
+        self._route_append = observed_jit("collect/route_append", jax.jit(
+            shard_map(
+                _route_append, mesh=self.mesh,
+                in_specs=(row2,) * 4 + (spec,) * 5,
+                out_specs=(row2,) * 4 + (spec, P()),
+            ), donate_argnums=(0, 1, 2, 3, 4)))
 
         def _grow(bh, bl, bdh, bdl, pad):
             filler = jnp.full((1, pad), jnp.uint32(SENTINEL))
@@ -121,10 +124,10 @@ class ShardedCollectEngine:
                          for b in (bh, bl, bdh, bdl))
 
         def _make_grow(pad):
-            return jax.jit(shard_map(
+            return observed_jit("collect/grow", jax.jit(shard_map(
                 partial(_grow, pad=pad), mesh=self.mesh,
                 in_specs=(row2,) * 4, out_specs=(row2,) * 4),
-                donate_argnums=(0, 1, 2, 3))
+                donate_argnums=(0, 1, 2, 3)), tag=pad)
 
         self._make_grow = _make_grow
 
@@ -132,11 +135,11 @@ class ShardedCollectEngine:
             s = lax.sort((hi[0], lo[0], dhi[0], dlo[0]), num_keys=4)
             return tuple(x[None] for x in s)
 
-        self._sort = jax.jit(shard_map(
+        self._sort = observed_jit("collect/sort_sharded", jax.jit(shard_map(
             _sort, mesh=self.mesh,
             in_specs=(row2,) * 4,
             out_specs=(row2,) * 4,
-        ))
+        )))
 
     # host-read hooks: the multi-process subclass must replicate sharded
     # values before np.asarray can address them (DistributedCollectEngine)

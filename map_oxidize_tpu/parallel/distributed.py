@@ -293,11 +293,12 @@ def _make_flag_sum(mesh):
 
     from jax.sharding import PartitionSpec as P
 
+    from map_oxidize_tpu.obs.compile import observed_jit
     from map_oxidize_tpu.parallel.mesh import SHARD_AXIS
 
-    return jax.jit(shard_map(
+    return observed_jit("dist/flag_psum", jax.jit(shard_map(
         partial(jax.lax.psum, axis_name=SHARD_AXIS),
-        mesh=mesh, in_specs=P(SHARD_AXIS), out_specs=P()))
+        mesh=mesh, in_specs=P(SHARD_AXIS), out_specs=P())))
 
 
 def _any_remaining(engine, i_have_rows: bool) -> bool:
@@ -764,6 +765,7 @@ def finish_distributed_obs(obs: Obs, config: JobConfig, workload: str
         sample_host_memory,
     )
 
+    xprof_report = obs.finish_xprof()
     sample_host_memory(obs.registry)
     sample_device_memory(obs.registry)
     if obs.heartbeat is not None:
@@ -771,6 +773,10 @@ def finish_distributed_obs(obs: Obs, config: JobConfig, workload: str
     P_ = obs.n_processes
     meta = obs.stamp(config, workload)
     metrics_doc = dict(obs.registry.to_dict(), meta=meta)
+    if xprof_report is not None:
+        # per-process xprof shards merge like everything else: each
+        # process's metrics doc carries its own program table
+        metrics_doc["xprof"] = xprof_report
     if config.metrics_out:
         # one document per process (counters are per-process facts); the
         # suffix keeps P writers off one file
@@ -821,6 +827,15 @@ def _obs_barrier() -> None:
     from jax.experimental import multihost_utils
 
     multihost_utils.sync_global_devices("moxt_obs_shards")
+
+
+def _kmeans_ckpt_barrier() -> None:
+    """Rendezvous after process 0 arbitrates the checkpoint identity
+    (and possibly clears a stale snapshot) and before the other
+    processes read it."""
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices("moxt_kmeans_ckpt")
 
 
 def _run_distributed_distinct(config: JobConfig, obs: Obs
@@ -890,7 +905,14 @@ def _run_distributed_kmeans(config: JobConfig, obs: Obs
     to every host, e.g. shared storage on a pod); centroids stay
     replicated, and the one ``(k, d+1)`` psum per iteration is the only
     cross-process traffic.  Returns replicated centroids; process 0 writes
-    ``--output`` (identical on every process by construction)."""
+    ``--output`` (identical on every process by construction).
+
+    With ``config.checkpoint_dir`` (shared storage, like the input),
+    process 0 snapshots the replicated centroids each iteration through
+    the atomic checkpoint machinery and every process resumes them —
+    the same continue-training semantics as the single-controller
+    driver, with a lockstep start-iteration check so a non-shared dir
+    fails loudly instead of silently diverging trajectories."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -899,10 +921,6 @@ def _run_distributed_kmeans(config: JobConfig, obs: Obs
 
     proc = jax.process_index()
     n_proc = jax.process_count()
-    if config.checkpoint_dir:
-        _log.warning("--checkpoint-dir has no effect on distributed "
-                     "kmeans yet: centroids are replicated and iterations "
-                     "restart cheaply relative to the points load")
     pts = np.load(config.input_path, mmap_mode="r")
     if pts.ndim != 2:
         raise ValueError(f"k-means input must be (n, d); got {pts.shape}")
@@ -919,6 +937,55 @@ def _run_distributed_kmeans(config: JobConfig, obs: Obs
     if S % n_proc:
         raise ValueError(f"shard count {S} must divide by process count "
                          f"{n_proc}")
+
+    # --- checkpoint/resume: same iteration-boundary snapshot contract as
+    # the single-controller driver (centroids fully summarize progress).
+    # Process 0 WRITES the per-iteration snapshot through the shared
+    # atomic checkpoint machinery; EVERY process reads it at start — the
+    # checkpoint dir must be on shared storage, like the input .npy (the
+    # module contract), and a lockstep allgather verifies every process
+    # resumed the same iteration before any collective runs, so a
+    # non-shared dir fails loudly instead of diverging trajectories.
+    store = None
+    start_iter = 0
+    if config.checkpoint_dir:
+        import hashlib
+
+        from map_oxidize_tpu.runtime.checkpoint import CheckpointStore
+
+        meta = CheckpointStore.job_meta(config, "kmeans", extra={
+            "kmeans_k": k,
+            "kmeans_mode": "dist_device",
+            "kmeans_shards": S,
+            "dist_processes": n_proc,
+            "kmeans_backend": config.backend,
+            "kmeans_precision": config.kmeans_precision,
+            "kmeans_init": hashlib.sha256(
+                centroids.tobytes()).hexdigest()[:16],
+        })
+        if proc == 0:
+            # only process 0 arbitrates identity (and clears a stale
+            # foreign snapshot); the others wait, then read
+            store = CheckpointStore(config.checkpoint_dir, meta)
+        _kmeans_ckpt_barrier()
+        if proc != 0:
+            store = CheckpointStore(config.checkpoint_dir, meta)
+        snap = store.load_snapshot()
+        if snap is not None:
+            state, _d, start_iter, _nc, _x = snap
+            centroids = np.asarray(state["centroids"], np.float32)
+        from jax.experimental import multihost_utils
+
+        its = np.asarray(multihost_utils.process_allgather(
+            np.array([start_iter], np.int32))).reshape(-1)
+        if its.size and (its.min() != its.max()):
+            raise RuntimeError(
+                f"distributed kmeans resume diverged: processes loaded "
+                f"iterations {its.tolist()} — --checkpoint-dir must be on "
+                "storage shared by every process")
+        if start_iter:
+            _log.info("distributed k-means resumed at iteration %d",
+                      start_iter)
     # global row padding to a multiple of S (zero-weight rows never move a
     # centroid), then contiguous per-process blocks of n_pad/P rows — the
     # rows this process's mesh slice addresses
@@ -940,30 +1007,74 @@ def _run_distributed_kmeans(config: JobConfig, obs: Obs
     w_local[:take] = 1.0
 
     row = NamedSharding(mesh, P(SHARD_AXIS))
+    rep = jax.jit(lambda x: x, out_shardings=NamedSharding(mesh, P()))
+    remaining = config.kmeans_iters - start_iter
     with obs.phase("transfer"):
         p_dev = jax.make_array_from_process_local_data(row, local,
                                                        (n_pad, d))
         w_dev = jax.make_array_from_process_local_data(row, w_local,
                                                        (n_pad,))
-    fit_fn = make_fit_fn(mesh, k, d, config.kmeans_iters,
-                         config.kmeans_precision)
-    rep = jax.jit(lambda x: x, out_shardings=NamedSharding(mesh, P()))
     with obs.phase("iterate"):
-        out = np.asarray(rep(fit_fn(
-            p_dev, w_dev,
-            jax.device_put(centroids, NamedSharding(mesh, P())))))
+        if remaining <= 0:
+            # the snapshot already covers every requested iteration: the
+            # snapshotted state IS the result (continue-training read,
+            # same semantics as the single-controller driver)
+            if remaining < 0:
+                _log.warning(
+                    "checkpoint has %d iterations, more than the %d "
+                    "requested; returning the snapshotted state",
+                    start_iter, config.kmeans_iters)
+            out = centroids
+        elif store is not None:
+            # checkpointing steps one compiled iteration at a time:
+            # points stay sharded in HBM, only the replicated (k, d)
+            # centroids cross back for process 0's snapshot — the same
+            # one-dispatch-per-iteration trade as kmeans_fit_sharded's
+            # on_iter mode
+            from map_oxidize_tpu.ops.hashing import HashDictionary
+
+            step_fn = make_fit_fn(mesh, k, d, 1, config.kmeans_precision)
+            c = jax.device_put(centroids, NamedSharding(mesh, P()))
+            for i in range(remaining):
+                c = step_fn(p_dev, w_dev, c)
+                done = start_iter + i + 1
+                c_np = np.asarray(rep(c))
+                if proc == 0:
+                    store.save_snapshot(
+                        {"centroids": np.asarray(c_np, np.float32)},
+                        HashDictionary(), done, done)
+                if obs.heartbeat is not None:
+                    obs.heartbeat.update(
+                        rows=int(take),
+                        fraction=min(done / config.kmeans_iters, 1.0))
+            out = np.asarray(c_np, np.float32)
+        else:
+            fit_fn = make_fit_fn(mesh, k, d, remaining,
+                                 config.kmeans_precision)
+            out = np.asarray(rep(fit_fn(
+                p_dev, w_dev,
+                jax.device_put(centroids, NamedSharding(mesh, P())))))
     if config.output_path and proc == 0:
         from map_oxidize_tpu.workloads.kmeans import write_centroids
 
         with obs.phase("write"):
             write_centroids(config.output_path, out)
+    ran_iters = max(remaining, 0)
+    if store is not None and proc == 0:
+        # a zero-work run only READ the continue-training state; deleting
+        # its snapshot then would destroy progress (single-controller
+        # contract).  Other processes never touch the store.
+        store.finish(config.keep_intermediates or ran_iters == 0)
     _log.info("distributed kmeans: %d processes, %d points, k=%d, %d "
-              "iterations", n_proc, n, k, config.kmeans_iters)
-    obs.registry.set("records_in", int(take) * config.kmeans_iters)
+              "iterations (%d resumed)", n_proc, n, k,
+              start_iter + ran_iters, start_iter)
+    obs.registry.set("records_in", int(take) * ran_iters)
     obs.registry.set("points", int(n))
-    obs.registry.set("iters", config.kmeans_iters)
+    obs.registry.set("iters", start_iter + ran_iters)
+    if start_iter:
+        obs.registry.set("resumed_iters", start_iter)
     result = DistributedResult(counts=None, top=[], n_keys=0,
-                               records=int(take) * config.kmeans_iters,
+                               records=int(take) * ran_iters,
                                centroids=out)
     result.metrics, result.trace = finish_distributed_obs(obs, config,
                                                           "kmeans")

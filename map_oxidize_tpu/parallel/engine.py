@@ -73,13 +73,15 @@ class ShardedReduceEngine(StreamingEngineBase):
         # jitted fill with out_shardings: materializes directly on the mesh
         # (no host buffer over the slow link) and never touches the default
         # device — the mesh may be virtual CPUs while a sick TPU is default
-        init = jax.jit(
+        from map_oxidize_tpu.obs.compile import observed_jit
+
+        init = observed_jit("shuffle/init_acc", jax.jit(
             lambda: make_accumulator(
                 self.capacity * self.S, self.value_shape, self.value_dtype,
                 self.combine, xp=jnp,
             ),
             out_shardings=self._sharding,
-        )
+        ), tag=(self.capacity, self.S, str(self.value_dtype)))
         self._acc = list(init())
         # [S] cumulative dropped-row counter (exchange-bucket drops plus
         # accumulator truncation), threaded through every merge

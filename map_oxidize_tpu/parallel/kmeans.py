@@ -58,11 +58,13 @@ def make_fit_fn(mesh, k: int, d: int, loop_iters: int,
 
         return lax.fori_loop(0, loop_iters, step, c)
 
-    return jax.jit(shard_map(
+    from map_oxidize_tpu.obs.compile import observed_jit
+
+    return observed_jit("kmeans/fit_sharded", jax.jit(shard_map(
         fit, mesh=mesh,
         in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P()),
         out_specs=P(),
-    ))
+    )), tag=(k, loop_iters, precision))
 
 
 #: cache of jitted streamed-step executables keyed by
@@ -119,16 +121,19 @@ def _build_stream_step(mesh, k: int, precision: str, first: bool,
         return jnp.where(counts[:, None] > 0,
                          sums / jnp.maximum(counts[:, None], 1.0), c)
 
+    from map_oxidize_tpu.obs.compile import observed_jit
+
     # acc is donated across chunk steps (it is replaced every step) —
     # except on the FIRST step, whose acc input is ignored and reused
     # across iterations (donating would invalidate the zero block the
     # next iteration passes again), and the LAST, whose (k, d) output
     # cannot reuse the (k, d+1) buffer anyway
-    return jax.jit(shard_map(
+    return observed_jit("kmeans/stream_step", jax.jit(shard_map(
         body, mesh=mesh,
         in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(), P()),
         out_specs=P(),
-    ), donate_argnums=(3,) if not (first or last) else ())
+    ), donate_argnums=(3,) if not (first or last) else ()),
+        tag=(k, precision, first, last))
 
 
 def kmeans_fit_streamed(path: str, centroids, iters: int = 1,

@@ -211,7 +211,10 @@ def build_sharded_ops(mesh, combine: str = "sum", bucket_cap: int = 0,
         in_specs=(spec,) * 7,
         out_specs=(spec, spec, spec, spec, spec),
     )
-    merge = jax.jit(merge, donate_argnums=(0, 1, 2, 3))
+    from map_oxidize_tpu.obs.compile import observed_jit
+
+    merge = observed_jit("shuffle/merge",
+                         jax.jit(merge, donate_argnums=(0, 1, 2, 3)))
 
     @lru_cache(maxsize=None)
     def _topk_compiled(k_local: int, k_final: int):
@@ -225,7 +228,8 @@ def build_sharded_ops(mesh, combine: str = "sum", bucket_cap: int = 0,
             out_specs=(P(), P(), P()),
             check_vma=False,
         )
-        return jax.jit(f)
+        return observed_jit("shuffle/top_k", jax.jit(f),
+                            tag=(k_local, k_final))
 
     def grow_fn(acc_hi, acc_lo, acc_vals, pad_per_shard: int):
         """Grow each shard's accumulator by ``pad_per_shard`` SENTINEL rows.
@@ -247,7 +251,12 @@ def build_sharded_ops(mesh, combine: str = "sum", bucket_cap: int = 0,
 
         f = shard_map(_grow, mesh=mesh, in_specs=(spec,) * 3,
                           out_specs=(spec,) * 3)
-        return jax.jit(f, donate_argnums=(0, 1, 2))(acc_hi, acc_lo, acc_vals)
+        # a fresh jit per growth step: each growth genuinely IS a new
+        # program (new accumulator shape), which the compile ledger
+        # records under one name — capacity-growth compile chains show up
+        # as shuffle/grow compiles with cause new_input_shape
+        return observed_jit("shuffle/grow", jax.jit(
+            f, donate_argnums=(0, 1, 2)))(acc_hi, acc_lo, acc_vals)
 
     def topk_fn(acc_hi, acc_lo, acc_vals, k: int):
         cap_per_shard = acc_hi.shape[0] // S

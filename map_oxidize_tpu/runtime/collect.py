@@ -30,6 +30,7 @@ from jax import lax
 
 from map_oxidize_tpu.api import MapOutput
 from map_oxidize_tpu.config import JobConfig
+from map_oxidize_tpu.obs.compile import observed_jit
 from map_oxidize_tpu.ops.hashing import SENTINEL
 from map_oxidize_tpu.runtime.engine import next_pow2, pick_device
 from map_oxidize_tpu.utils.logging import get_logger
@@ -37,6 +38,7 @@ from map_oxidize_tpu.utils.logging import get_logger
 _log = get_logger(__name__)
 
 
+@partial(observed_jit, "collect/sort")
 @jax.jit
 def _sort_pairs(stacked):
     """Sort a ``(4, N)`` packed pair block lexicographically by all four

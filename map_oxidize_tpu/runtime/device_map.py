@@ -49,9 +49,11 @@ _log = get_logger(__name__)
 def _prefix_packer(m: int):
     """[3, m] uint32 overflow fetch, used only when per-chunk novelty
     exceeds the kernel's pre-packed ``fetch_keys`` rows."""
+    from map_oxidize_tpu.obs.compile import observed_jit
+
     def pack(hi, lo, reps):
         return jnp.stack([hi[:m], lo[:m], reps[:m].astype(jnp.uint32)])
-    return jax.jit(pack)
+    return observed_jit("device_map/prefix_pack", jax.jit(pack), tag=m)
 
 
 class _DictBuilder:
@@ -167,14 +169,16 @@ def _run_sharded_device_body(config: JobConfig, obs, ngram: int) -> JobResult:
     row_spec = NamedSharding(mesh, P(SHARD_AXIS))
     tables = tuple(jax.device_put(t, rep_spec) for t in pk)
 
-    group_fn = jax.jit(shard_map(
+    from map_oxidize_tpu.obs.compile import observed_jit
+
+    group_fn = observed_jit("device_map/tokenize_group", jax.jit(shard_map(
         lambda chunk, a, b, c, d: tokenize_count_core(
             chunk, a, b, c, d, max_tokens=max_tokens, out_keys=out_keys,
             fetch_keys=fetch, ngram=ngram),
         mesh=mesh,
         in_specs=(P(SHARD_AXIS), P(), P(), P(), P()),
         out_specs=P(SHARD_AXIS),
-    ))
+    )), tag=(S, out_keys, ngram))
 
     dicts = [_DictBuilder(out_keys, fetch, ngram) for _ in range(S)]
     pending: tuple | None = None

@@ -43,6 +43,7 @@ import jax.numpy as jnp
 
 from map_oxidize_tpu.api import MapOutput, Reducer
 from map_oxidize_tpu.config import JobConfig
+from map_oxidize_tpu.obs.compile import observed_jit
 from map_oxidize_tpu.ops.hashing import SENTINEL
 from map_oxidize_tpu.ops.segment_reduce import (
     _identity,
@@ -80,6 +81,7 @@ def next_pow2(n: int) -> int:
     return 1 << max(n - 1, 1).bit_length()
 
 
+@partial(observed_jit, "engine/grow_concat")
 @partial(jax.jit, donate_argnums=(0, 1, 2))
 def _grow_concat(hi, lo, vals, p_hi, p_lo, p_vals):
     return (jnp.concatenate([hi, p_hi]), jnp.concatenate([lo, p_lo]),

@@ -274,8 +274,12 @@ def _make_jitted():
     import jax
     from jax import lax
 
-    step = jax.jit(_kmeans_step_impl, static_argnums=(2, 3))
+    from map_oxidize_tpu.obs.compile import observed_jit
 
+    step = observed_jit("kmeans/step",
+                        jax.jit(_kmeans_step_impl, static_argnums=(2, 3)))
+
+    @functools.partial(observed_jit, "kmeans/fit")
     @functools.partial(jax.jit, static_argnums=(2, 3, 4))
     def fit(c, p, k, iters, precision):
         return lax.fori_loop(

@@ -22,9 +22,13 @@ import sys
 with open(f"{sys.argv[1]}/corpus.txt", "wb") as f:
     f.write(b"the quick brown fox jumps over the lazy dog\n" * 200)
 EOF
+# --num-chunks 16 --batch-size 64: several same-shape merges, so the run
+# has steady-state (non-compiling) dispatches and the dispatch-gap
+# histogram populates alongside the exact compile counts
 for _ in 1 2; do
     JAX_PLATFORMS=cpu python -m map_oxidize_tpu wordcount \
         "$smoke/corpus.txt" --output "$smoke/out.txt" --num-shards 1 \
+        --num-chunks 16 --batch-size 64 \
         --quiet --trace-out "$smoke/trace.json" \
         --metrics-out "$smoke/metrics.json" --ledger-dir "$smoke/ledger" \
         > /dev/null
@@ -40,8 +44,27 @@ assert m["meta"]["config_hash"] and m["meta"]["version"], "stamp missing"
 assert m["phases_s"]["map+reduce"] > 0
 led = [json.loads(l) for l in open(f"{d}/ledger/ledger.jsonl")]
 assert len(led) == 2, f"expected 2 ledger entries, got {len(led)}"
-print("obs artifacts OK")
+# xprof smoke: the observatory saw the fold engine's programs with EXACT
+# compile counts (one shape set each on a one-flush corpus), the cost
+# join has FLOPs/bytes, and both ledger entries carry the gate fields
+x = m.get("xprof") or {}
+progs = x.get("programs") or {}
+for prog in ("engine/merge_packed", "engine/pack_finalize"):
+    assert progs.get(prog, {}).get("compiles") == 1, (
+        f"xprof: expected exactly 1 compile of {prog}, got "
+        f"{progs.get(prog)}")
+    assert progs[prog].get("bytes_per_dispatch"), f"no cost join for {prog}"
+for e in led:
+    assert e["metrics"].get("compile/engine/merge_packed/compiles") == 1, \
+        "ledger entry lacks exact compile counts"
+assert "device/dispatch_gap_ms" in m.get("histograms", {}), \
+    "dispatch-gap histogram missing"
+print("obs artifacts OK (xprof: "
+      f"{x.get('total_compiles')} compiles / "
+      f"{x.get('total_dispatches')} dispatches)")
 EOF
+# the observatory report must render from the metrics document
+python -m map_oxidize_tpu obs xprof "$smoke/metrics.json" | head -5
 # previous vs last (informational: same config, tiny run — deltas are
 # jitter), then a gated self-diff that MUST come back all-zero
 python -m map_oxidize_tpu obs diff --ledger-dir "$smoke/ledger"

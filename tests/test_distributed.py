@@ -29,6 +29,9 @@ final = sys.argv[8] if len(sys.argv) > 8 and sys.argv[8] != "-" else ""
 precision = "highest"
 if workload == "kmeans_bf16":  # kmeans with the bf16 storage/matmul mode
     workload, precision = "kmeans", "bf16"
+kmeans_resume = workload == "kmeans_resume"
+if kmeans_resume:
+    workload = "kmeans"
 from map_oxidize_tpu.config import JobConfig
 from map_oxidize_tpu.parallel.distributed import (
     init_distributed, run_distributed_job)
@@ -53,6 +56,13 @@ cfg = JobConfig(input_path=corpus, output_path=final, chunk_bytes=4096,
                 metrics=False, checkpoint_dir=ckpt,
                 keep_intermediates=bool(ckpt),
                 kmeans_k=4, kmeans_iters=3, kmeans_precision=precision)
+if kmeans_resume:
+    # interrupted-training shape: 2 iterations snapshot (kept), then a
+    # 3-iteration run resumes the snapshot and runs only the last one
+    import dataclasses
+    run_distributed_job(dataclasses.replace(
+        cfg, kmeans_iters=2, keep_intermediates=True), "kmeans")
+    cfg = dataclasses.replace(cfg, keep_intermediates=False)
 r = run_distributed_job(cfg, workload)
 payload = {
     "n_keys": r.n_keys, "n_pairs": r.n_pairs, "records": r.records,
@@ -63,6 +73,7 @@ payload = {
             for h, w, c in r.top],
     "counts": {str(k): v for k, v in (r.counts or {}).items()},
     "centroids": None if r.centroids is None else r.centroids.tolist(),
+    "resumed_iters": (r.metrics or {}).get("resumed_iters", 0),
 }
 with open(out_path, "w") as f:
     json.dump(payload, f, sort_keys=True)
@@ -352,6 +363,32 @@ def test_two_process_kmeans_matches_single_controller(tmp_path):
         want = kmeans_model(pts, want)
     np.testing.assert_allclose(got[0], want, rtol=1e-3, atol=1e-3)
     np.testing.assert_array_equal(np.load(out), got[0])
+
+
+def test_two_process_kmeans_checkpoint_resume(tmp_path):
+    """Distributed k-means checkpoint/resume (the last 'no effect on
+    distributed kmeans' carve-out, removed this round): a 2-iteration run
+    snapshots per iteration through process 0 (kept), then a 3-iteration
+    run resumes the snapshot on BOTH processes and runs only the final
+    iteration.  The resumed trajectory must match the straight 3-iteration
+    single-controller fit within collective-order tolerance, both
+    processes must agree bitwise, and the metrics must record the resume
+    (resumed_iters == 2)."""
+    rng = np.random.default_rng(7)
+    pts = rng.normal(size=(1000, 8)).astype(np.float32)
+    path = tmp_path / "pr.npy"
+    np.save(path, pts)
+    ckpt = str(tmp_path / "kckpt")
+    results, _ = _launch(tmp_path, path, 2, "kmeans_resume", ckpt=ckpt)
+    got = [np.array(r["centroids"], np.float32) for r in results]
+    np.testing.assert_array_equal(got[0], got[1])
+    assert [r["resumed_iters"] for r in results] == [2, 2]
+
+    from map_oxidize_tpu.parallel.kmeans import kmeans_fit_sharded
+
+    single = kmeans_fit_sharded(pts, pts[:4].copy(), iters=3,
+                                num_shards=8, backend="cpu")
+    np.testing.assert_allclose(got[0], single, rtol=2e-6, atol=2e-7)
 
 
 def test_two_process_kmeans_bf16_matches_sharded(tmp_path):
