@@ -467,11 +467,15 @@ def _bench_ledger_entries(headline, workloads) -> list:
         if rate is None:
             continue
         metrics = {"rate": rate, "vs_baseline": e.get("vs_baseline")}
-        # XLA-layer gate fields ride along: a recompile or an MFU drop in
-        # a benchmarked workload fails --gate exactly like a rate drop
+        # XLA- and comms-layer gate fields ride along: a recompile, an
+        # MFU drop, unexplained comms-bytes growth, or a stall episode
+        # in a benchmarked workload fails --gate exactly like a rate
+        # drop (the comms bytes are deterministic accounting identities,
+        # so same-config entries compare exactly)
         metrics.update({k: v for k, v in e.get("metrics_snapshot",
                                                {}).items()
-                        if k.startswith(("compile/", "xprof/"))})
+                        if k.startswith(("compile/", "xprof/", "comms/",
+                                         "heartbeat/"))})
         entry = dict(base, workload=f"bench/{name}", metrics=metrics)
         if "ab_pairs" in e:
             # these entries switched measurement method (best-of ->
@@ -582,7 +586,7 @@ def _metrics_snapshot(result) -> dict:
             if k.startswith(("time/", "spill/", "demote/", "checkpoint/",
                              "shuffle/", "engine/", "mem/", "pipeline/",
                              "feed_block_ms/", "compile/", "xprof/",
-                             "device/", "hbm/"))}
+                             "device/", "hbm/", "comms/", "heartbeat/"))}
     return snap
 
 

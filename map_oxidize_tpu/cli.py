@@ -155,6 +155,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="stall detector: warn with the open span names "
                         "when no chunk completes within this multiple of "
                         "the median chunk time; 0 = off")
+    p.add_argument("--obs-port", type=int, default=-1,
+                   help="live telemetry: serve /metrics (Prometheus), "
+                        "/status (JSON), and /series on this 127.0.0.1 "
+                        "port while the job runs (0 = ephemeral, port "
+                        "logged; distributed runs serve one port per "
+                        "process); -1 = off.  Watch with "
+                        "`python -m map_oxidize_tpu obs top --url ...`")
+    p.add_argument("--obs-sample-interval", type=float, default=0.0,
+                   help="time-series recorder: seconds between ring-"
+                        "buffer snapshots of every counter/gauge/"
+                        "histogram quantile (metrics doc `series` "
+                        "section + /series endpoint); 0 = off unless "
+                        "--obs-port is set (then 1s)")
     p.add_argument("--keep-intermediates", action="store_true")
     p.add_argument("-v", "--verbose", action="store_true")
     p.add_argument("-q", "--quiet", action="store_true")
@@ -194,6 +207,8 @@ def config_from_args(args: argparse.Namespace) -> JobConfig:
         progress_interval_s=args.progress_interval,
         hbm_sample_s=args.hbm_sample_interval,
         stall_warn_factor=args.stall_factor,
+        obs_port=args.obs_port,
+        obs_sample_s=args.obs_sample_interval,
         rescan_full=args.rescan_full,
         collect_max_rows=args.collect_max_rows,
         hll_precision=args.hll_precision,

@@ -112,6 +112,17 @@ class JobConfig:
     #: of the median inter-chunk interval, naming the open spans.  0
     #: disables (the default — tests and short jobs stay silent)
     stall_warn_factor: float = 0.0
+    #: live telemetry HTTP server (obs/serve.py): the port this job's
+    #: /metrics + /status + /series endpoints bind on 127.0.0.1.
+    #: 0 = ephemeral (the bound port is logged); -1 disables (default).
+    #: Distributed runs: every process serves its own port — ephemeral
+    #: stays ephemeral, a fixed port offsets by the process slot.
+    obs_port: int = -1
+    #: time-series recorder (obs/timeseries.py): seconds between ring-
+    #: buffer snapshots of every counter/gauge/histogram-quantile (the
+    #: metrics doc's ``series`` section + the live /series endpoint).
+    #: 0 = off, unless --obs-port is set (serving implies sampling, 1s)
+    obs_sample_s: float = 0.0
     #: multi-host: coordination-service address ("host:port"); empty = the
     #: single-process path.  With it set, dist_num_processes and
     #: dist_process_id select this process's slot; jax.distributed is
@@ -196,6 +207,17 @@ class JobConfig:
             raise ValueError("hbm_sample_s must be >= 0 (0 = off)")
         if self.stall_warn_factor < 0:
             raise ValueError("stall_warn_factor must be >= 0 (0 = off)")
+        if self.obs_port < -1 or self.obs_port > 65535:
+            raise ValueError(
+                "obs_port must be -1 (off), 0 (ephemeral), or a port")
+        if (self.obs_port > 0 and self.dist_num_processes > 1
+                and self.obs_port + self.dist_num_processes - 1 > 65535):
+            raise ValueError(
+                f"obs_port {self.obs_port} + the per-process offset for "
+                f"{self.dist_num_processes} processes exceeds 65535; "
+                "use a lower port or 0 (ephemeral)")
+        if self.obs_sample_s < 0:
+            raise ValueError("obs_sample_s must be >= 0 (0 = off)")
         from map_oxidize_tpu.workloads.distinct import HLL_P_MIN, HLL_P_MAX
 
         if not HLL_P_MIN <= self.hll_precision <= HLL_P_MAX:

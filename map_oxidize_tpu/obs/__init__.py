@@ -37,6 +37,22 @@ The job-level legs on top of the per-process bundle:
   before propagating, and ``Obs.recording`` is the crash-safe envelope
   every driver wraps its body in.
 
+The live telemetry plane on top of all of it (ISSUE-6):
+
+* :mod:`~map_oxidize_tpu.obs.timeseries` — the ring-buffer time-series
+  recorder (``--obs-sample-interval``): bounded timestamped series of
+  every counter/gauge/quantile, exported as the metrics document's
+  ``series`` section;
+* :mod:`~map_oxidize_tpu.obs.serve` — the per-process HTTP server
+  (``--obs-port``): ``/metrics`` (Prometheus), ``/status`` (live phase/
+  progress/compile/MFU/comms; skew-aware aggregate on process 0),
+  ``/series`` — shut down by ``finish`` AND the flight recorder;
+* the **comms observatory**: every collective site records payload
+  bytes + sampled latency (``MetricsRegistry.comm``) into per-
+  (collective, program, shape) tables the ledger gate checks;
+* :mod:`~map_oxidize_tpu.obs.context` — per-job routing so concurrent
+  jobs in one process keep disjoint obs state.
+
 See ``docs/OBSERVABILITY.md`` for the event model and flag reference.
 """
 
@@ -93,6 +109,15 @@ class Obs:
     #: compile-ledger snapshot taken at job start — finish deltas the
     #: process-global ledger against it for per-job xprof numbers
     xprof_base: "dict | None" = None
+    #: live telemetry plane (``--obs-port`` / ``--obs-sample-interval``):
+    #: the HTTP status server and the ring-buffer time-series recorder —
+    #: both stopped by finish AND the flight recorder
+    server: "object | None" = None
+    series: "object | None" = None
+    #: the phase currently open (obs.phase) and the workload under
+    #: recording — what /status reports while the job runs
+    current_phase: "str | None" = None
+    workload: "str | None" = None
 
     @classmethod
     def from_config(cls, config, process: int = 0,
@@ -106,29 +131,42 @@ class Obs:
         noise; ``MOXT_PROGRESS_ALL_PROCS=1`` un-silences the rest for
         per-process debugging)."""
         tracer = Tracer(enabled=bool(config.trace_out))
+        obs_port = getattr(config, "obs_port", -1)
+        sample_s = getattr(config, "obs_sample_s", 0.0)
+        live = obs_port >= 0 or sample_s > 0
+        if live and sample_s <= 0:
+            sample_s = 1.0  # serving implies sampling: /series must work
         hb = None
-        if getattr(config, "progress", False):
+        if getattr(config, "progress", False) or live:
             total = None
             try:
                 total = os.path.getsize(config.input_path)
             except OSError:
                 pass
             emit = None
-            wanted = True
-            if n_processes > 1:
+            # silent heartbeat: the live plane needs the row/phase/ETA
+            # accumulation for /status even when --progress printing is
+            # off — emit becomes a no-op, the tracking is identical
+            silent = not getattr(config, "progress", False)
+            if n_processes > 1 and not silent:
                 if process != 0 and not os.environ.get(
                         "MOXT_PROGRESS_ALL_PROCS"):
-                    wanted = False
+                    silent = True  # lockstep: P copies of a line are noise
                 else:
                     from map_oxidize_tpu.utils.logging import get_logger
 
                     plog = get_logger(__name__)
                     emit = (lambda line, _p=process:
                             plog.info("[proc %d] %s", _p, line))
-            if wanted:
+            if silent and not live:
+                pass  # progress off, no live plane: no heartbeat at all
+            else:
+                if silent:
+                    emit = lambda line: None
                 hb = Heartbeat(total_bytes=total,
                                interval_s=config.progress_interval_s,
                                emit=emit)
+                hb.silent = silent
         obs = cls(registry=MetricsRegistry(), tracer=tracer, heartbeat=hb,
                   process=process, n_processes=n_processes)
         # the XLA program observatory is always-on: compile counts, costs
@@ -139,12 +177,32 @@ class Obs:
         obs.xprof_base = _compile.LEDGER.activate(obs)
         hbm_s = getattr(config, "hbm_sample_s", 0.0)
         stall = getattr(config, "stall_warn_factor", 0.0)
+        if live and hbm_s <= 0:
+            # the live plane implies the HBM sampler: /status and the
+            # time series carry hbm/live_bytes at the sample cadence
+            hbm_s = sample_s
         if hbm_s > 0 or stall > 0:
             from map_oxidize_tpu.obs.xprof import DeviceSampler
 
             obs.sampler = DeviceSampler(obs, interval_s=hbm_s,
                                         stall_factor=stall)
             obs.sampler.start()
+        if sample_s > 0:
+            from map_oxidize_tpu.obs.timeseries import TimeSeriesRecorder
+
+            obs.series = TimeSeriesRecorder(obs.registry,
+                                            interval_s=sample_s,
+                                            heartbeat=obs.heartbeat)
+            obs.series.start()
+        if obs_port >= 0:
+            from map_oxidize_tpu.obs.serve import (
+                ObsServer,
+                serve_port_for_process,
+            )
+
+            obs.server = ObsServer(
+                obs, config, serve_port_for_process(obs_port, process))
+            obs.server.start()
         return obs
 
     @contextlib.contextmanager
@@ -155,11 +213,13 @@ class Obs:
         peaks: finalize fetches, sort buffers, write staging)."""
         if self.heartbeat is not None:
             self.heartbeat.set_phase(name)
+        prev, self.current_phase = self.current_phase, name
         with self.tracer.span(f"phase/{name}", **attrs):
             with self.registry.phase(name):
                 try:
                     yield
                 finally:
+                    self.current_phase = prev
                     sample_host_memory(self.registry)
 
     def feed_span(self, **attrs) -> "Span":
@@ -184,6 +244,16 @@ class Obs:
             "wall_start_unix_s": round(self.tracer.wall_start, 6),
         }
 
+    def stop_live(self) -> None:
+        """Quiesce the live telemetry plane: stop the HTTP server (no
+        scrape may observe a half-finished export) and the time-series
+        recorder (which takes its final sample).  Idempotent; called by
+        ``finish`` AND the flight recorder."""
+        if self.server is not None:
+            self.server.stop()
+        if self.series is not None:
+            self.series.stop()
+
     def finish_xprof(self) -> dict | None:
         """Close the job's XLA observatory window: stop the sampler,
         release the compile-ledger hookup, and fold the per-job delta
@@ -198,11 +268,11 @@ class Obs:
         if self.sampler is not None:
             self.sampler.stop()
             self.sampler = None
-        _compile.LEDGER.deactivate(self)
+        local = _compile.LEDGER.deactivate(self)
         base, self.xprof_base = self.xprof_base, None
         if base is None:
             return None
-        report = xprof.job_report(_compile.LEDGER.job_delta(base))
+        report = xprof.job_report(_compile.LEDGER.job_delta(base, local))
         for k, v in xprof.flatten_report(report).items():
             self.registry.set(k, v)
         return report
@@ -214,6 +284,7 @@ class Obs:
         optional ledger append, and the ``(summary, trace_events)`` pair
         the result carries.  ``trace_events`` is None when tracing was
         off."""
+        self.stop_live()
         xprof_report = self.finish_xprof()
         sample_host_memory(self.registry)
         sample_device_memory(self.registry)
@@ -224,6 +295,8 @@ class Obs:
             doc = dict(self.registry.to_dict(), meta=meta)
             if xprof_report is not None:
                 doc["xprof"] = xprof_report
+            if self.series is not None:
+                doc["series"] = self.series.export()
             write_json_atomic(config.metrics_out, doc)
         trace = self.tracer.chrome_trace() if self.tracer.enabled else None
         if trace is not None:
@@ -238,9 +311,11 @@ class Obs:
         if getattr(config, "ledger_dir", None):
             from map_oxidize_tpu.obs import ledger
 
+            comms = self.registry.comms_table()
             ledger.append(config.ledger_dir, ledger.build_entry(
                 config, workload or "?", summary,
-                n_processes=self.n_processes))
+                n_processes=self.n_processes,
+                extra={"comms": comms} if comms else None))
         return summary, trace
 
     @contextlib.contextmanager
@@ -249,9 +324,18 @@ class Obs:
         flight recorder closes open spans, flushes the partial metrics/
         trace to their configured paths, and dumps a post-mortem bundle
         under ``config.crash_dir`` — then the exception propagates
-        unchanged.  Zero cost on the success path."""
+        unchanged.  Zero cost on the success path.
+
+        Also binds this bundle as the context's current job
+        (:mod:`map_oxidize_tpu.obs.context`), so per-dispatch
+        observations from concurrent jobs in one process route to their
+        own registries."""
+        from map_oxidize_tpu.obs.context import use_obs
+
+        self.workload = workload
         try:
-            yield self
+            with use_obs(self):
+                yield self
         except BaseException as exc:
             from map_oxidize_tpu.obs import flight
 

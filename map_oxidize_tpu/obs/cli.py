@@ -1,7 +1,6 @@
 """``python -m map_oxidize_tpu obs ...`` — observability artifact tools.
 
-Three subcommands, all pure host-side file work (no jax, no backend
-init):
+Four subcommands, all pure host-side work (no jax, no backend init):
 
 * ``obs merge`` — combine a distributed run's per-process trace shards
   (``<trace_out>.proc<i>``) into one Chrome trace (pid = process slot)
@@ -12,16 +11,25 @@ init):
   (``--ledger-dir``'s ``ledger.jsonl``): per-phase and per-counter
   deltas, identity-checked (workload, config hash, version) so
   apples-to-oranges comparisons refuse by default; ``--gate`` exits
-  nonzero when a regression exceeds the threshold.
+  nonzero when a regression exceeds the threshold.  ``--crash-dir``
+  diffs a flight-recorder bundle against the ledger directly — no
+  hand-extracting the metrics document from the bundle.
 * ``obs xprof`` — render the XLA program observatory report from a run's
-  ``--metrics-out`` document (or an obs shard): per-program compile
-  counts with recompile causes, FLOPs/bytes from ``cost_analysis``,
-  achieved-vs-peak utilization, and the dispatch-gap histogram summary.
+  ``--metrics-out`` document, an obs shard, or a ``--crash-dir`` bundle
+  directory: per-program compile counts with recompile causes,
+  FLOPs/bytes from ``cost_analysis``, achieved-vs-peak utilization, and
+  the dispatch-gap histogram summary.
+* ``obs top`` — live terminal view of a running job: polls the
+  ``--obs-port`` server's ``/status`` and redraws phase, rows/sec, ETA,
+  the compile/MFU table, HBM, and the comms table.  Curses-free (plain
+  ANSI redraw), so it works in any terminal and over ssh.
 """
 
 from __future__ import annotations
 
 import argparse
+import glob
+import os
 import sys
 
 
@@ -61,16 +69,40 @@ def build_obs_parser() -> argparse.ArgumentParser:
     d.add_argument("--force", action="store_true",
                    help="diff even when workload/config-hash/version "
                         "differ (mismatches print as warnings)")
+    d.add_argument("--crash-dir", default=None,
+                   help="diff a flight-recorder crash bundle (the bundle "
+                        "directory, or a --crash-dir root — the newest "
+                        "bundle is picked) against the most recent "
+                        "comparable ledger entry")
 
     x = sub.add_parser(
         "xprof", help="render the XLA program observatory report (compile "
                       "ledger, cost/MFU join, dispatch-gap histograms) "
-                      "from a --metrics-out document")
-    x.add_argument("metrics", help="a run's --metrics-out JSON (or a "
-                                   "<metrics_out>.proc<i> shard document)")
+                      "from a --metrics-out document or a crash bundle")
+    x.add_argument("metrics", help="a run's --metrics-out JSON, a "
+                                   "<metrics_out>.proc<i> shard document, "
+                                   "or a flight-recorder --crash-dir "
+                                   "bundle directory (its metrics.json "
+                                   "is used; a crash-dir root resolves "
+                                   "to the newest bundle)")
     x.add_argument("--json", action="store_true",
                    help="emit the structured report as JSON instead of "
                         "the rendered tables")
+
+    t = sub.add_parser(
+        "top", help="live terminal view of a running job: poll the "
+                    "--obs-port server's /status and redraw")
+    t.add_argument("--url", required=True,
+                   help="the job's obs server, e.g. http://127.0.0.1:8321 "
+                        "(the [obs] serving log line prints it)")
+    t.add_argument("--interval", type=float, default=2.0,
+                   help="seconds between polls (default 2)")
+    t.add_argument("--iterations", type=int, default=0,
+                   help="stop after N polls (0 = until the job's server "
+                        "goes away or Ctrl-C)")
+    t.add_argument("--no-clear", action="store_true",
+                   help="append refreshes instead of redrawing in place "
+                        "(log-friendly)")
     return p
 
 
@@ -80,7 +112,26 @@ def obs_main(argv: list[str]) -> int:
         return _merge(args)
     if args.cmd == "xprof":
         return _xprof(args)
+    if args.cmd == "top":
+        return _top(args)
     return _diff(args)
+
+
+def resolve_metrics_path(path: str) -> str:
+    """A metrics-document argument may be the JSON itself, a flight-
+    recorder BUNDLE directory (its ``metrics.json``), or a ``--crash-dir``
+    root (the newest ``crash_*`` bundle inside — the stamp prefix sorts
+    chronologically)."""
+    if not os.path.isdir(path):
+        return path
+    direct = os.path.join(path, "metrics.json")
+    if os.path.isfile(direct):
+        return direct
+    bundles = sorted(glob.glob(os.path.join(path, "crash_*",
+                                            "metrics.json")))
+    if bundles:
+        return bundles[-1]
+    return path
 
 
 def _xprof(args) -> int:
@@ -88,11 +139,12 @@ def _xprof(args) -> int:
 
     from map_oxidize_tpu.obs.xprof import render_report
 
+    path = resolve_metrics_path(args.metrics)
     try:
-        with open(args.metrics) as f:
+        with open(path) as f:
             doc = json.load(f)
     except (OSError, ValueError) as e:
-        print(f"error: cannot read metrics document {args.metrics!r}: {e}",
+        print(f"error: cannot read metrics document {path!r}: {e}",
               file=sys.stderr)
         return 2
     if doc.get("schema"):  # an obs shard: the metrics doc nests inside
@@ -134,37 +186,192 @@ def _merge(args) -> int:
 
 
 def _diff(args) -> int:
+    import json
+
     from map_oxidize_tpu.obs import ledger
 
-    entries = ledger.read(args.ledger_dir, args.workload)
+    crash_entry = None
+    workload = args.workload
+    if args.crash_dir:
+        path = resolve_metrics_path(args.crash_dir)
+        try:
+            with open(path) as f:
+                crash_entry = ledger.entry_from_metrics_doc(json.load(f))
+        except (OSError, ValueError) as e:
+            print(f"error: cannot read crash bundle metrics {path!r}: {e}",
+                  file=sys.stderr)
+            return 2
+        if workload is None:
+            workload = crash_entry.get("workload")
+    entries = ledger.read(args.ledger_dir, workload)
     if not entries:
         print(f"error: no ledger entries under {args.ledger_dir}"
-              + (f" for workload {args.workload!r}" if args.workload
+              + (f" for workload {workload!r}" if workload
                  else ""), file=sys.stderr)
         return 2
-    specs = args.runs if args.runs else ["-2", "-1"]
-    if len(specs) != 2:
-        print("error: diff takes exactly two entry indices",
-              file=sys.stderr)
-        return 2
-    try:
-        idx = [int(s) for s in specs]
-    except ValueError:
-        print(f"error: run specs must be integer indices, got {specs}",
-              file=sys.stderr)
-        return 2
-    try:
-        a, b = entries[idx[0]], entries[idx[1]]
-    except IndexError:
-        print(f"error: ledger has {len(entries)} entries; indices {idx} "
-              "out of range", file=sys.stderr)
-        return 2
+    if crash_entry is not None:
+        # before = a chosen (default: last) ledger entry, after = the
+        # crashed run's partial metrics — "what changed before it died"
+        specs = args.runs if args.runs else ["-1"]
+        if len(specs) != 1:
+            print("error: --crash-dir takes at most one ledger index "
+                  "(the entry to compare the bundle against)",
+                  file=sys.stderr)
+            return 2
+        try:
+            a = entries[int(specs[0])]
+        except (ValueError, IndexError):
+            print(f"error: bad ledger index {specs[0]!r} "
+                  f"({len(entries)} entries)", file=sys.stderr)
+            return 2
+        b = crash_entry
+    else:
+        specs = args.runs if args.runs else ["-2", "-1"]
+        if len(specs) != 2:
+            print("error: diff takes exactly two entry indices",
+                  file=sys.stderr)
+            return 2
+        try:
+            idx = [int(s) for s in specs]
+        except ValueError:
+            print(f"error: run specs must be integer indices, got {specs}",
+                  file=sys.stderr)
+            return 2
+        try:
+            a, b = entries[idx[0]], entries[idx[1]]
+        except IndexError:
+            print(f"error: ledger has {len(entries)} entries; indices "
+                  f"{idx} out of range", file=sys.stderr)
+            return 2
     try:
         diff = ledger.diff_entries(a, b, args.threshold_pct, args.force)
     except ledger.LedgerMismatch as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    if crash_entry is not None and crash_entry.get("aborted"):
+        print("NOTE: comparing against a crash bundle (partial metrics "
+              "as of the abort); phase times and totals read low")
     print(ledger.format_diff(a, b, diff))
     if args.gate and diff["regressions"]:
         return 3
     return 0
+
+
+# --- obs top ---------------------------------------------------------------
+
+
+def _fmt_bytes(n) -> str:
+    if not isinstance(n, (int, float)):
+        return "-"
+    for scale, suffix in ((1 << 40, "TB"), (1 << 30, "GB"), (1 << 20, "MB"),
+                          (1 << 10, "KB")):
+        if n >= scale:
+            return f"{n / scale:.2f}{suffix}"
+    return f"{n:.0f}B"
+
+
+def render_status(doc: dict) -> str:
+    """One ``obs top`` frame from a ``/status`` document.  Pure, so tests
+    pin the rendering without a server."""
+    meta = doc.get("meta", {})
+    head = (f"moxt obs top — {meta.get('workload') or '?'} "
+            f"v{meta.get('version', '?')} cfg {meta.get('config_hash')}")
+    if doc.get("n_processes", 1) > 1:
+        head += f"  [proc {doc.get('process')}/{doc.get('n_processes')}]"
+    lines = [head]
+    line = (f"phase={doc.get('phase') or '?'} "
+            f"elapsed={doc.get('elapsed_s', 0):.1f}s")
+    prog = doc.get("progress") or {}
+    if prog:
+        line += (f" rows={prog.get('rows', 0):,} "
+                 f"({prog.get('rows_per_sec', 0):,.0f} rows/s)")
+        if prog.get("fraction") is not None:
+            line += f" {100 * prog['fraction']:.1f}%"
+        if prog.get("eta_s") is not None:
+            line += f" eta={prog['eta_s']:.0f}s"
+        if prog.get("hbm_bytes") is not None:
+            line += f" hbm={_fmt_bytes(prog['hbm_bytes'])}"
+    lines.append(line)
+    stalls = (doc.get("counters") or {}).get("heartbeat/stalls")
+    if stalls:
+        lines.append(f"!! {stalls:g} stall episode(s)")
+    xprof = doc.get("xprof") or {}
+    progs = xprof.get("programs") or {}
+    if progs:
+        lines.append(
+            f"programs ({xprof.get('total_compiles', 0)} compiles, "
+            f"{xprof.get('total_dispatches', 0)} dispatches):")
+        lines.append(f"  {'program':<28} {'n':>3} {'disp':>6} {'MFU%':>6} "
+                     f" bound")
+        ranked = sorted(progs.items(),
+                        key=lambda kv: -kv[1].get("dispatches", 0))
+        for name, r in ranked[:8]:
+            lines.append(
+                f"  {name:<28} {r.get('compiles', 0):>3} "
+                f"{r.get('dispatches', 0):>6} "
+                f"{r.get('mfu_pct', '-'):>6}  {r.get('bound', '-')}")
+    comms = doc.get("comms") or []
+    if comms:
+        lines.append("comms:")
+        lines.append(f"  {'collective':<11} {'program':<24} {'shape':<12} "
+                     f"{'calls':>6} {'bytes':>9} {'p50 ms':>7}")
+        for c in comms[:8]:
+            lat = c.get("latency_ms") or {}
+            p50 = lat.get("p50")
+            lines.append(
+                f"  {c['collective']:<11} {c['program']:<24} "
+                f"{c['shape']:<12} {c['count']:>6} "
+                f"{_fmt_bytes(c['bytes']):>9} "
+                f"{p50 if p50 is not None else '-':>7}")
+    agg = doc.get("aggregate")
+    if agg:
+        lines.append(
+            f"aggregate (x{agg.get('n_processes')}): "
+            f"~{agg.get('est_rows_per_sec', 0):,.0f} rows/s global, "
+            f"collective wait {agg.get('collective_wait_s', 0):.2f}s "
+            f"({100 * agg.get('collective_wait_frac', 0):.1f}% of wall)")
+    spans = doc.get("open_spans")
+    if spans:
+        lines.append("open spans: " + "; ".join(spans[:4]))
+    return "\n".join(lines)
+
+
+def _top(args) -> int:
+    import json
+    import time
+    import urllib.error
+    import urllib.request
+
+    url = args.url.rstrip("/") + "/status"
+    polls = 0
+    seen_one = False
+    try:
+        while True:
+            try:
+                with urllib.request.urlopen(url, timeout=5) as resp:
+                    doc = json.loads(resp.read())
+            except (urllib.error.URLError, OSError, ValueError) as e:
+                if seen_one:
+                    # the server going away after healthy polls means
+                    # the job finished — a clean exit, not an error
+                    print("job's obs server went away (job finished?)")
+                    return 0
+                print(f"error: cannot reach {url}: {e}", file=sys.stderr)
+                return 2
+            seen_one = True
+            frame = render_status(doc)
+            if args.no_clear:
+                print(frame)
+                print("-" * 40)
+            else:
+                # ANSI clear + home: curses-free redraw-in-place
+                sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+                sys.stdout.flush()
+            polls += 1
+            if args.iterations and polls >= args.iterations:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        # Ctrl-C anywhere in the poll cycle (a blocked fetch included)
+        # is "stop watching", never a traceback
+        return 0

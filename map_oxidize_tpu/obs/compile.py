@@ -84,27 +84,84 @@ class CompileLedger:
     def __init__(self):
         self._lock = threading.Lock()
         self.programs: dict[str, ProgramStats] = {}
-        self._active = None       # the recording job's Obs (or None)
+        self._active = None       # the latest-activated job's Obs (or None)
         self._active_base: dict = {}  # its activation snapshot
+        #: ALL currently-recording jobs: id(obs) -> [obs, base snapshot,
+        #: local overlay].  Dispatch observations route to the CONTEXT's
+        #: job first (obs.context — set by Obs.recording), falling back
+        #: to the latest-activated one, so two concurrent jobs in one
+        #: process keep disjoint histograms, warnings, sample cadences,
+        #: AND per-job compile/dispatch counts: the overlay accumulates
+        #: only the activity routed to that job, where the global-minus-
+        #: baseline delta would credit every concurrent job with the
+        #: union.
+        self._actives: dict[int, list] = {}
         self._tls = threading.local()
         self._listener_on = False
 
     # --- job lifecycle ----------------------------------------------------
 
     def activate(self, obs) -> dict:
-        """Mark ``obs`` as the recording job; returns the baseline
+        """Mark ``obs`` as a recording job; returns the baseline
         snapshot its finish will delta against."""
         with self._lock:
             self._active = obs
             self._active_base = {n: p.snapshot()
                                  for n, p in self.programs.items()}
+            self._actives[id(obs)] = [obs, dict(self._active_base), {}]
             return dict(self._active_base)
 
-    def deactivate(self, obs) -> None:
+    def deactivate(self, obs) -> "dict | None":
+        """Close the job's window; returns its local overlay (the per-job
+        activity record ``job_delta`` consumes)."""
         with self._lock:
+            entry = self._actives.pop(id(obs), None)
             if self._active is obs:
-                self._active = None
-                self._active_base = {}
+                if self._actives:
+                    # another job is still recording: it becomes the
+                    # fallback for context-less dispatch sites
+                    other = next(iter(self._actives.values()))
+                    self._active, self._active_base = other[0], other[1]
+                else:
+                    self._active = None
+                    self._active_base = {}
+        return entry[2] if entry is not None else None
+
+    def overlay(self, obs) -> "dict | None":
+        """Copy of a still-active job's local overlay (the live /status
+        table reads this without closing the window)."""
+        with self._lock:
+            entry = self._actives.get(id(obs))
+            return ({n: dict(r, causes=list(r["causes"]))
+                     for n, r in entry[2].items()}
+                    if entry is not None else None)
+
+    def _job(self) -> list:
+        """The [obs, baseline, overlay] a dispatch observation belongs
+        to: the context-bound job when one is recording, else the latest
+        activated (single-job processes never notice the difference)."""
+        from map_oxidize_tpu.obs.context import current_obs
+
+        cur = current_obs()
+        if cur is not None:
+            entry = self._actives.get(id(cur))
+            if entry is not None:
+                return entry
+        entry = self._actives.get(id(self._active))
+        if entry is not None:
+            return entry
+        return [self._active, self._active_base, None]
+
+    @staticmethod
+    def _local_row(local: dict, name: str) -> dict:
+        row = local.get(name)
+        if row is None:
+            row = local[name] = {
+                "compiles": 0, "compile_ms": 0.0,
+                "backend_compile_ms": 0.0, "dispatches": 0,
+                "dispatch_ms": 0.0, "sampled_ms": 0.0, "samples": 0,
+                "causes": []}
+        return row
 
     # --- recording (called from ObservedJit) ------------------------------
 
@@ -140,7 +197,8 @@ class CompileLedger:
                 self._listener_on = True
 
     def record_compile(self, stats: ProgramStats, sig, cause: str,
-                       wall_ms: float, cost) -> None:
+                       wall_ms: float, cost,
+                       backend_ms: float = 0.0) -> None:
         with self._lock:
             stats.compiles += 1
             stats.compile_ms += wall_ms
@@ -150,21 +208,32 @@ class CompileLedger:
                 stats.sigs[sig] = cost
             if cost is not None:
                 stats.flops, stats.bytes_accessed = cost
-        obs = self._active
+        obs, base, local = self._job()
+        job_compiles = stats.compiles - base.get(stats.name, (0,))[0]
+        if local is not None:
+            with self._lock:
+                row = self._local_row(local, stats.name)
+                row["compiles"] += 1
+                row["compile_ms"] += wall_ms
+                row["backend_compile_ms"] += backend_ms
+                if cause != "first":
+                    row["causes"].append(cause)
+                job_compiles = row["compiles"]
         # warn on the job's OWN recompiles only: a later job in the same
         # process legitimately compiles programs an earlier job already
         # ran (new static configs, new shapes) — the per-job delta the
         # gate reads handles those; the live warning is for a program
         # compiling twice within ONE job (a shape-set leak in flight)
-        job_compiles = stats.compiles - self._active_base.get(
-            stats.name, (0,))[0]
         if job_compiles > 1 and obs is not None:
             line = (f"[xprof] recompile #{job_compiles} of {stats.name} "
                     f"this job: {cause} ({len(stats.sigs)} input-shape "
                     "sets)")
-            if obs.heartbeat is not None:
-                obs.heartbeat._emit(line)
+            hb = obs.heartbeat
+            if hb is not None and not getattr(hb, "silent", False):
+                hb._emit(line)
             else:
+                # a silent tracking-only heartbeat (live plane without
+                # --progress) must not swallow the warning
                 _log.warning("%s", line)
 
     def record_dispatch(self, stats: ProgramStats, gap_ms: float,
@@ -179,7 +248,16 @@ class CompileLedger:
             if ready_ms is not None:
                 stats.sampled_ms += ready_ms
                 stats.samples += 1
-        obs = self._active
+        obs, _base, local = self._job()
+        if local is not None:
+            with self._lock:
+                row = self._local_row(local, stats.name)
+                row["dispatches"] += 1
+                if not compiled:
+                    row["dispatch_ms"] += gap_ms
+                if ready_ms is not None:
+                    row["sampled_ms"] += ready_ms
+                    row["samples"] += 1
         if obs is not None:
             if not compiled:
                 obs.registry.observe("device/dispatch_gap_ms", gap_ms)
@@ -188,12 +266,42 @@ class CompileLedger:
 
     # --- export -----------------------------------------------------------
 
-    def job_delta(self, baseline: dict) -> dict:
-        """Per-program activity since ``baseline`` (programs with zero
-        compiles AND zero dispatches in the window are omitted)."""
+    def job_delta(self, baseline: dict, local: "dict | None" = None
+                  ) -> dict:
+        """Per-program activity for one job window (programs with zero
+        compiles AND zero dispatches in the window are omitted).
+
+        With ``local`` (the overlay ``deactivate``/``overlay`` return),
+        counts come from the activity actually ROUTED to that job — the
+        only correct accounting when jobs overlap in one process.
+        Without it, the global-minus-``baseline`` delta is used (exact
+        for the one-job-at-a-time case; pre-overlay callers keep their
+        semantics).  Cost facts (FLOPs/bytes, shape sets) are global
+        program properties either way."""
         out = {}
         with self._lock:
             items = list(self.programs.items())
+        if local is not None:
+            stats = dict(items)
+            for name, row in local.items():
+                if row["compiles"] <= 0 and row["dispatches"] <= 0:
+                    continue
+                p = stats.get(name)
+                out[name] = {
+                    "compiles": row["compiles"],
+                    "compile_ms": round(row["compile_ms"], 3),
+                    "backend_compile_ms": round(
+                        row["backend_compile_ms"], 3),
+                    "dispatches": row["dispatches"],
+                    "dispatch_ms": round(row["dispatch_ms"], 3),
+                    "sampled_device_ms": round(row["sampled_ms"], 3),
+                    "device_samples": row["samples"],
+                    "recompile_causes": list(row["causes"]),
+                    "shape_sets": len(p.sigs) if p is not None else 0,
+                    "flops_per_dispatch": p.flops if p else None,
+                    "bytes_per_dispatch": p.bytes_accessed if p else None,
+                }
+            return out
         for name, p in items:
             b = baseline.get(name, (0, 0.0, 0.0, 0, 0.0, 0.0, 0, 0))
             compiles = p.compiles - b[0]
@@ -323,6 +431,7 @@ class ObservedJit:
         tls = led._tls
         prev_cur = getattr(tls, "current", None)
         tls.current = stats
+        bc0 = stats.backend_compile_ms
         t0 = time.perf_counter()
         try:
             out = self._fn(*args, **kw)
@@ -337,7 +446,8 @@ class ObservedJit:
                      else _classify(sig, stats.sigs)
                      if new_sig else "retrace_same_signature")
             led.record_compile(stats, sig if new_sig else None, cause,
-                               gap_ms, cost)
+                               gap_ms, cost,
+                               backend_ms=stats.backend_compile_ms - bc0)
         elif new_sig:
             # the signature is new to the ledger but this jit already had
             # it cached (a pre-activation warm call): remember it so cost
@@ -347,13 +457,19 @@ class ObservedJit:
                 if cost is not None and stats.flops is None:
                     stats.flops, stats.bytes_accessed = cost
         ready_ms = None
-        # sample on the JOB-relative dispatch ordinal (delta from the
-        # activation baseline), not the process-lifetime one: the first
-        # dispatch of every job is always sampled, so the MFU join never
-        # silently flips between the sampled-ready-wait and
-        # dispatch-wall estimators across the runs a gate compares
-        base = led._active_base.get(self._name)
-        n = stats.dispatches - (base[3] if base else 0) + 1
+        # sample on the JOB-relative dispatch ordinal (the overlay's own
+        # count, falling back to the delta from the activation
+        # baseline), not the process-lifetime one: the first dispatch of
+        # every job is always sampled, so the MFU join never silently
+        # flips between the sampled-ready-wait and dispatch-wall
+        # estimators across the runs a gate compares
+        _obs, jbase, jlocal = led._job()
+        if jlocal is not None:
+            lrow = jlocal.get(self._name)
+            n = (lrow["dispatches"] if lrow else 0) + 1
+        else:
+            base = jbase.get(self._name)
+            n = stats.dispatches - (base[3] if base else 0) + 1
         if n <= 1 or n % self._sample_every == 0 or compiled:
             t1 = time.perf_counter()
             try:

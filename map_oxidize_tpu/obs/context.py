@@ -1,0 +1,49 @@
+"""Per-job observability context: which ``Obs`` owns the current work.
+
+The compile ledger is process-global (jit executable caches are), but the
+histograms, heartbeat warnings, and comms rows a dispatch produces belong
+to ONE job.  With a single job per process the ledger's "active Obs"
+pointer was enough; a resident server multiplexing concurrent jobs
+(ROADMAP open item 2) breaks that — two jobs' dispatches would interleave
+into whichever bundle activated last.
+
+:func:`use_obs` binds an ``Obs`` to the calling context (a
+``contextvars.ContextVar``, so each job thread carries its own binding);
+``Obs.recording`` enters it automatically, which means every driver body
+is already context-scoped.  Consumers (:mod:`map_oxidize_tpu.obs.compile`)
+route per-dispatch observations to :func:`current_obs` first and fall
+back to the ledger's last-activated job — the single-job behavior is
+unchanged, and two concurrent jobs in one process get disjoint
+metrics/ledger state (pinned by tests/test_obs_live.py).
+
+Note threads do NOT inherit a parent thread's binding: worker threads
+that record (the device sampler, the time-series recorder) hold their
+``Obs`` by reference instead, and the dispatch sites all run on the
+job's driver thread, inside ``recording``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+
+_CURRENT: contextvars.ContextVar = contextvars.ContextVar(
+    "moxt_current_obs", default=None)
+
+
+def current_obs():
+    """The ``Obs`` bound to this context, or None outside any job body."""
+    return _CURRENT.get()
+
+
+@contextlib.contextmanager
+def use_obs(obs):
+    """Bind ``obs`` as this context's job for the duration of the block.
+    Re-entrant: an inner binding (a nested job, e.g. a bench harness
+    running a job inside a job) shadows the outer one and restores it on
+    exit."""
+    token = _CURRENT.set(obs)
+    try:
+        yield obs
+    finally:
+        _CURRENT.reset(token)

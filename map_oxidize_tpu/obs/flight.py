@@ -65,6 +65,10 @@ def _record(obs, config, exc, workload):
 
     err = f"{type(exc).__name__}: {exc}"
     obs.tracer.close_open_spans(error=err)
+    # the live plane shuts down FIRST: the status server must not serve a
+    # half-recorded crash, and the time-series recorder takes its final
+    # sample so the bundle's series ends at the crash instant
+    obs.stop_live()
     # the xprof window closes here too: the sampler takes a final HBM
     # reading before stopping, and the compile/dispatch accounting as of
     # the crash lands in the bundle (an abort mid-recompile-storm is
@@ -78,6 +82,8 @@ def _record(obs, config, exc, workload):
     metrics_doc = dict(obs.registry.to_dict(), meta=meta)
     if xprof_report is not None:
         metrics_doc["xprof"] = xprof_report
+    if obs.series is not None:
+        metrics_doc["series"] = obs.series.export()
     trace = obs.tracer.chrome_trace() if obs.tracer.enabled else None
     if trace is not None:
         trace.insert(0, {"name": "moxt_meta", "ph": "M",

@@ -49,6 +49,11 @@ class Heartbeat:
         #: live HBM in use (max over devices), fed by the obs device
         #: sampler thread when one is running; None keeps it off the line
         self.hbm_bytes: int | None = None
+        #: True when this heartbeat only TRACKS progress (the live
+        #: telemetry plane's /status feed) and emits no lines — warning
+        #: producers (stall detector, recompile warnings) must then fall
+        #: back to the logger instead of emitting into the void
+        self.silent = False
 
     def set_phase(self, name: str) -> None:
         self.phase = name

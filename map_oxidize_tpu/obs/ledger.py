@@ -38,6 +38,7 @@ _NON_IDENTITY_FIELDS = frozenset({
     "trace_dir", "trace_out", "metrics_out", "metrics", "progress",
     "progress_interval_s", "ledger_dir", "crash_dir",
     "hbm_sample_s", "stall_warn_factor",
+    "obs_port", "obs_sample_s",
     "dist_coordinator", "dist_process_id",
 })
 
@@ -85,6 +86,37 @@ def build_entry(config, workload: str, summary: dict,
     if extra:
         entry.update(extra)
     return entry
+
+
+def entry_from_metrics_doc(doc: dict) -> dict:
+    """Synthesize a ledger-shaped entry from a structured metrics
+    document (a ``--metrics-out`` file or a flight-recorder bundle's
+    ``metrics.json``), so ``obs diff --crash-dir`` can compare a crashed
+    run against the ledger without hand-extraction.  The flat metrics
+    mirror :meth:`MetricsRegistry.summary`'s key shapes; ``corpus_bytes``
+    is unknown (the doc doesn't carry it) and the comparability check
+    treats None as 'unknown', not a mismatch."""
+    meta = doc.get("meta", {})
+    flat: dict = {}
+    flat.update(doc.get("counters", {}))
+    flat.update(doc.get("gauges", {}))
+    for name, h in doc.get("histograms", {}).items():
+        for stat in ("p50", "p95", "max", "count"):
+            flat[f"{name}/{stat}"] = h.get(stat)
+    phases = doc.get("phases_s", {})
+    for k, v in phases.items():
+        flat[f"time/{k}_s"] = v
+    return {
+        "ts_unix_s": meta.get("wall_start_unix_s"),
+        "version": meta.get("version"),
+        "config_hash": meta.get("config_hash"),
+        "workload": meta.get("workload"),
+        "corpus_bytes": None,
+        "n_processes": meta.get("n_processes", 1),
+        "phases_s": dict(phases),
+        "metrics": flat,
+        "aborted": bool(doc.get("gauges", {}).get("aborted")),
+    }
 
 
 def append(ledger_dir: str, entry: dict) -> str:
@@ -141,9 +173,13 @@ def check_comparable(a: dict, b: dict, force: bool = False) -> list[str]:
     a 64MB run gating a 10GB run's phase times."""
     problems = []
     for key in ("workload", "config_hash", "version", "corpus_bytes"):
-        if a.get(key) != b.get(key):
-            problems.append(
-                f"{key} differs: {a.get(key)!r} vs {b.get(key)!r}")
+        va, vb = a.get(key), b.get(key)
+        if key == "corpus_bytes" and (va is None or vb is None):
+            # None = unknown (a crash-bundle entry), not a mismatch —
+            # the other identity fields still guard the comparison
+            continue
+        if va != vb:
+            problems.append(f"{key} differs: {va!r} vs {vb!r}")
     if problems and not force:
         raise LedgerMismatch(
             "entries are not comparable (" + "; ".join(problems)
@@ -206,6 +242,30 @@ def diff_entries(a: dict, b: dict, threshold_pct: float = 10.0,
             if pct is not None and pct < -threshold_pct:
                 regressions.append(
                     f"{name}: {va:.2f}% -> {vb:.2f}% ({pct:.1f}%)")
+        elif name.startswith("comms/") and name.endswith("/bytes"):
+            # comms observatory gate: bytes moved over the interconnect
+            # growing past the threshold for the same workload/config is
+            # an unexplained redistribution regression (Exoshuffle's
+            # argument: shuffle bytes are the cost model, so silent
+            # growth IS the bug) — a collective appearing from nothing
+            # (va missing/0) flags too
+            if va != vb:
+                rows.append((name, va, vb, pct))
+            vb_n = vb if isinstance(vb, (int, float)) else 0
+            va_n = va if isinstance(va, (int, float)) else 0
+            if vb_n > va_n and (pct is None or pct > threshold_pct):
+                regressions.append(
+                    f"{name}: {va_n:,.0f} -> {vb_n:,.0f} bytes "
+                    "(unexplained comms growth)")
+        elif name == "heartbeat/stalls":
+            # stall episodes are evidence of a wedged feed loop or a
+            # straggler-gated collective; ANY increase flags
+            if va != vb:
+                rows.append((name, va, vb, pct))
+            va_n = va if isinstance(va, (int, float)) else 0
+            if isinstance(vb, (int, float)) and vb > va_n:
+                regressions.append(
+                    f"{name}: {va_n:g} -> {vb:g} stall episodes")
         elif va != vb:
             rows.append((name, va, vb, pct))
     return {"rows": rows, "regressions": regressions, "warnings": warnings}

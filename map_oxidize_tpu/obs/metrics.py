@@ -98,6 +98,9 @@ class MetricsRegistry:
         self.counters: dict[str, float] = {}
         self.gauges: dict[str, float] = {}
         self.histograms: dict[str, Histogram] = {}
+        #: comms observatory rows: (collective, program, shape) ->
+        #: {count, bytes, latency Histogram} — see :meth:`comm`
+        self._comms: dict[tuple, dict] = {}
         self._lock = threading.Lock()
 
     # --- seed-compatible surface -----------------------------------------
@@ -149,6 +152,55 @@ class MetricsRegistry:
         finally:
             self.observe(name, (time.perf_counter() - t0) * 1e3)
 
+    # --- comms observatory ------------------------------------------------
+
+    def comm(self, collective: str, program: str, nbytes: float,
+             shape=None, latency_ms: float | None = None) -> None:
+        """Record one collective invocation: ``collective`` is the
+        primitive (``all_to_all`` / ``psum`` / ``all_gather``),
+        ``program`` the observed-jit program (or host call site) it runs
+        in, ``nbytes`` the global payload the invocation moved (the
+        host-side accounting identity — XLA's collectives can't
+        self-report), ``shape`` the per-shard buffer shape.  Accumulates
+        the per-(collective, program, shape) table the metrics document
+        exports (``comms`` section) AND the flat
+        ``comms/<collective>/<program>/{bytes,calls}`` counters the run
+        ledger and ``obs diff --gate`` compare.  ``latency_ms`` is the
+        sampled per-invocation wall where the site measures one (host-
+        synchronous collectives every call; async dispatch sites on
+        their sampling cadence)."""
+        key = (collective, program, _shape_str(shape))
+        with self._lock:
+            row = self._comms.get(key)
+            if row is None:
+                row = self._comms[key] = {
+                    "count": 0, "bytes": 0.0, "latency": Histogram(1024)}
+            row["count"] += 1
+            row["bytes"] += nbytes
+            if latency_ms is not None:
+                row["latency"].observe(latency_ms)
+            for name, delta in (
+                    (f"comms/{collective}/{program}/bytes", nbytes),
+                    (f"comms/{collective}/{program}/calls", 1)):
+                self.counters[name] = self.counters.get(name, 0) + delta
+
+    def comms_table(self) -> list[dict]:
+        """The per-(collective, program, shape) rows, sorted by bytes
+        descending — the measurement substrate ROADMAP open item 5's
+        collective chooser consumes."""
+        rows = []
+        with self._lock:
+            for (collective, program, shape), r in self._comms.items():
+                lat = (r["latency"].summary() if r["latency"].count
+                       else None)
+                rows.append({
+                    "collective": collective, "program": program,
+                    "shape": shape, "count": r["count"],
+                    "bytes": int(r["bytes"]), "latency_ms": lat,
+                })
+        rows.sort(key=lambda row: -row["bytes"])
+        return rows
+
     # --- export -----------------------------------------------------------
 
     def summary(self) -> dict:
@@ -176,15 +228,59 @@ class MetricsRegistry:
 
     def to_dict(self) -> dict:
         """Structured export (the ``--metrics-out`` document): phases,
-        counters, gauges, and full histogram summaries, unflattened."""
+        counters, gauges, full histogram summaries, and the comms table,
+        unflattened."""
         with self._lock:
-            return {
+            out = {
                 "phases_s": {k: round(v, 6) for k, v in self.phases.items()},
                 "counters": dict(self.counters),
                 "gauges": dict(self.gauges),
                 "histograms": {k: h.summary()
                                for k, h in self.histograms.items()},
             }
+        comms = self.comms_table()
+        if comms:
+            out["comms"] = comms
+        return out
+
+
+def sample_collective_wall(holder, attr: str, t0: float,
+                           target) -> float | None:
+    """Shared sampling rule for async collective sites: on the 1st and
+    then every ``SAMPLE_EVERY``-th invocation (counted per ``holder``
+    via ``attr`` — the SAME cadence the xprof device-compute sampler
+    uses, so the forced sync is one the observatory was paying anyway),
+    force ``target`` with ``jax.block_until_ready`` and return the wall
+    since ``t0`` in ms — the containing dispatch's completion wall, the
+    honest latency figure available for a collective that lowers into a
+    larger program.  Returns None on unsampled invocations."""
+    n = getattr(holder, attr, 0) + 1
+    setattr(holder, attr, n)
+    from map_oxidize_tpu.obs.compile import SAMPLE_EVERY
+
+    if n != 1 and n % SAMPLE_EVERY != 0:
+        return None
+    try:
+        import jax
+
+        jax.block_until_ready(target)
+        return (time.perf_counter() - t0) * 1e3
+    except Exception:
+        return None
+
+
+def _shape_str(shape) -> str:
+    """Stable string key for a comms row's buffer shape.  Callers may pass
+    a tuple, an already-formatted string (shape plus a dtype tag), or
+    None (shapeless host collectives)."""
+    if shape is None:
+        return "-"
+    if isinstance(shape, str):
+        return shape
+    try:
+        return "x".join(str(int(d)) for d in shape)
+    except TypeError:
+        return str(shape)
 
 
 # --- memory watermarks ----------------------------------------------------

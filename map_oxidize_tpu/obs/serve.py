@@ -1,0 +1,320 @@
+"""Live telemetry HTTP plane: ``/metrics``, ``/status``, ``/series``.
+
+Until this module every obs artifact was post-hoc — traces, ledgers, and
+metrics documents appear at ``Obs.finish`` or a crash, so a running job
+was a black box.  ``--obs-port`` starts one stdlib
+``ThreadingHTTPServer`` per process (0 = ephemeral, the bound port is
+logged as ``[obs] serving ...``), live for the duration of the job and
+shut down cleanly by ``Obs.finish`` *and* the flight recorder:
+
+* ``GET /metrics`` — the registry in Prometheus text exposition format
+  (names sanitized to the Prometheus charset, counters/gauges typed,
+  histograms as summary quantiles) — point any Prometheus scraper at it;
+* ``GET /status``  — one JSON document a human dashboard (``python -m
+  map_oxidize_tpu obs top``) renders: current phase, rows/sec and ETA
+  from the heartbeat, the per-program compile/MFU table computed live
+  from the compile ledger, HBM watermarks, open span stacks, the comms
+  table, and — on process 0 of a distributed run — the skew-aware
+  aggregate estimate;
+* ``GET /series``  — the time-series ring
+  (:mod:`map_oxidize_tpu.obs.timeseries`) as aligned value lists.
+
+All three are snapshot reads built under the registry's lock, so
+concurrent scrapes during a hot feed loop are safe (pinned by
+tests/test_obs_live.py); nothing here dispatches device work, so the
+telemetry plane cannot cause recompiles.
+
+In distributed runs every process serves its own port: with
+``--obs-port 0`` each binds an ephemeral port; with a fixed port,
+process ``i`` binds ``port + i`` (one host running several processes
+must not collide).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from map_oxidize_tpu.utils.logging import get_logger
+
+_log = get_logger(__name__)
+
+STATUS_SCHEMA = "moxt-status-v1"
+
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def sanitize_metric_name(name: str) -> str:
+    """Prometheus metric-name charset: ``[a-zA-Z_:][a-zA-Z0-9_:]*``.
+    Slashes, +, - and friends become underscores; a leading digit gets a
+    prefix underscore.  Prefixed ``moxt_`` so scraped jobs namespace
+    cleanly next to other exporters."""
+    s = _PROM_BAD.sub("_", name)
+    if s and s[0].isdigit():
+        s = "_" + s
+    return f"moxt_{s}"
+
+
+def prometheus_text(registry, extra_labels: dict | None = None) -> str:
+    """The registry in Prometheus text exposition format (v0.0.4):
+    counters as ``counter``, gauges as ``gauge``, phase wall-clocks as a
+    labeled ``moxt_phase_seconds`` gauge, histograms as summary
+    quantiles plus ``_count``/``_sum``."""
+    labels = ""
+    if extra_labels:
+        inner = ",".join(f'{k}="{v}"' for k, v in sorted(
+            extra_labels.items()))
+        labels = "{" + inner + "}"
+
+    def _label(base: str, more: dict | None = None) -> str:
+        pairs = dict(extra_labels or {})
+        if more:
+            pairs.update(more)
+        if not pairs:
+            return base
+        inner = ",".join(f'{k}="{v}"' for k, v in sorted(pairs.items()))
+        return base + "{" + inner + "}"
+
+    with registry._lock:
+        phases = dict(registry.phases)
+        counters = dict(registry.counters)
+        gauges = {k: v for k, v in registry.gauges.items()
+                  if isinstance(v, (int, float))
+                  and not isinstance(v, bool)}
+        hists = {k: (h.count, h.total, h.quantile(0.5), h.quantile(0.95),
+                     h.max) for k, h in registry.histograms.items()}
+    lines: list[str] = []
+    if phases:
+        lines.append("# TYPE moxt_phase_seconds gauge")
+        for name, v in sorted(phases.items()):
+            lines.append(
+                f'{_label("moxt_phase_seconds", {"phase": name})} {v:.6f}')
+    for name, v in sorted(counters.items()):
+        m = sanitize_metric_name(name)
+        lines.append(f"# TYPE {m} counter")
+        lines.append(f"{m}{labels} {v:g}")
+    for name, v in sorted(gauges.items()):
+        m = sanitize_metric_name(name)
+        lines.append(f"# TYPE {m} gauge")
+        lines.append(f"{m}{labels} {v:g}")
+    for name, (count, total, p50, p95, mx) in sorted(hists.items()):
+        m = sanitize_metric_name(name)
+        lines.append(f"# TYPE {m} summary")
+        for q, v in (("0.5", p50), ("0.95", p95), ("1", mx)):
+            if v is not None:
+                lines.append(f'{_label(m, {"quantile": q})} {v:g}')
+        lines.append(f"{m}_count{labels} {count:g}")
+        lines.append(f"{m}_sum{labels} {total:g}")
+    return "\n".join(lines) + "\n"
+
+
+def build_status(obs, config, workload: str | None = None) -> dict:
+    """The ``/status`` JSON document, computed live from the job's obs
+    bundle.  Also the input to ``obs top``'s renderer — the two cannot
+    drift."""
+    now = time.time()
+    elapsed = max(now - obs.tracer.wall_start, 1e-9)
+    workload = workload if workload is not None else getattr(
+        obs, "workload", None)
+    doc: dict = {
+        "schema": STATUS_SCHEMA,
+        "meta": obs.stamp(config, workload),
+        "t_unix_s": round(now, 3),
+        "elapsed_s": round(elapsed, 3),
+        "phase": getattr(obs, "current_phase", None),
+    }
+    hb = obs.heartbeat
+    if hb is not None:
+        doc["phase"] = hb.phase or doc["phase"]
+        frac = hb._frac()
+        progress = {
+            "rows": hb.rows,
+            "rows_per_sec": round(hb.rows / elapsed, 1),
+            "bytes_done": hb.bytes_done,
+        }
+        if frac is not None:
+            progress["fraction"] = round(frac, 4)
+            if 0 < frac < 1:
+                progress["eta_s"] = round(elapsed * (1 - frac) / frac, 1)
+        if hb.hbm_bytes is not None:
+            progress["hbm_bytes"] = hb.hbm_bytes
+        doc["progress"] = progress
+    # live per-program compile/MFU table: the same join Obs.finish runs,
+    # against the job's live overlay in the compile ledger
+    if obs.xprof_base is not None:
+        from map_oxidize_tpu.obs import compile as _compile
+        from map_oxidize_tpu.obs import xprof
+
+        doc["xprof"] = xprof.job_report(_compile.LEDGER.job_delta(
+            obs.xprof_base, _compile.LEDGER.overlay(obs)))
+    with obs.registry._lock:
+        doc["hbm"] = {k: v for k, v in obs.registry.gauges.items()
+                      if k.startswith(("hbm/", "mem/"))}
+        doc["counters"] = {
+            k: v for k, v in obs.registry.counters.items()
+            if k.startswith(("heartbeat/", "stall", "pipeline/"))}
+    doc["comms"] = obs.registry.comms_table()
+    # open span stacks (what the job is doing RIGHT NOW), when tracing
+    if obs.tracer.enabled:
+        stacks = []
+        with obs.tracer._lock:
+            for _tid, stack in obs.tracer._stacks:
+                if stack:
+                    stacks.append(" > ".join(s.name for s in stack))
+        doc["open_spans"] = stacks
+    if obs.n_processes > 1:
+        doc["process"] = obs.process
+        doc["n_processes"] = obs.n_processes
+        if obs.process == 0:
+            doc["aggregate"] = _aggregate(obs, elapsed)
+    return doc
+
+
+def _aggregate(obs, elapsed: float) -> dict:
+    """Process 0's skew-aware global estimate.  Chunks partition
+    round-robin and processes advance in lockstep, so process 0's local
+    rate times P estimates the global rate; the honesty bound on that
+    symmetry assumption is the measured collective-wait fraction — the
+    share of wall this process spent blocked on the slowest participant
+    (``dist/flag_wait_ms``).  A high wait fraction means the estimate
+    leans on a straggler-gated denominator and global progress is
+    whatever the straggler allows."""
+    P = obs.n_processes
+    agg: dict = {"n_processes": P, "method": "lockstep-symmetric-estimate"}
+    hb = obs.heartbeat
+    if hb is not None:
+        agg["est_rows_total"] = hb.rows * P
+        agg["est_rows_per_sec"] = round(hb.rows * P / elapsed, 1)
+    with obs.registry._lock:
+        h = obs.registry.histograms.get("dist/flag_wait_ms")
+        wait_s = (h.total / 1e3) if h is not None else 0.0
+        rounds = h.count if h is not None else 0
+    agg["collective_wait_s"] = round(wait_s, 3)
+    agg["collective_rounds"] = rounds
+    agg["collective_wait_frac"] = round(min(wait_s / elapsed, 1.0), 4)
+    return agg
+
+
+class _Handler(BaseHTTPRequestHandler):
+    """GET-only; the obs bundle rides on the server object."""
+
+    server_version = "moxt-obs"
+
+    def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler contract
+        srv = self.server
+        path = self.path.split("?", 1)[0]
+        try:
+            if path in ("/", "/healthz"):
+                self._json({"endpoints": ["/metrics", "/status", "/series"],
+                            "schema": STATUS_SCHEMA})
+            elif path == "/metrics":
+                body = prometheus_text(
+                    srv.obs.registry,
+                    {"process": str(srv.obs.process)}
+                    if srv.obs.n_processes > 1 else None)
+                self._ok(body.encode(),
+                         "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "/status":
+                self._json(build_status(srv.obs, srv.config))
+            elif path == "/series":
+                tsr = getattr(srv.obs, "series", None)
+                if tsr is None:
+                    self._json({"error": "time-series recorder not "
+                                         "running (--obs-sample-interval)"},
+                               code=404)
+                else:
+                    self._json(tsr.export())
+            else:
+                self._json({"error": f"unknown path {path!r}"}, code=404)
+        except Exception as e:  # a scrape bug must not kill the job
+            try:
+                self._json({"error": f"{type(e).__name__}: {e}"}, code=500)
+            except Exception:
+                pass
+
+    def _ok(self, body: bytes, ctype: str, code: int = 200) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _json(self, doc: dict, code: int = 200) -> None:
+        from map_oxidize_tpu.obs import _json_default
+
+        body = json.dumps(doc, default=_json_default).encode()
+        self._ok(body, "application/json", code)
+
+    def log_message(self, fmt, *args):  # route access logs to debug
+        _log.debug("obs-serve: " + fmt, *args)
+
+
+class _Server(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+    # set by ObsServer after construction
+    obs = None
+    config = None
+
+
+class ObsServer:
+    """One job's telemetry server: a daemon ``serve_forever`` thread over
+    a :class:`ThreadingHTTPServer` (each scrape handled on its own
+    thread).  ``port=0`` binds an ephemeral port; the bound port is on
+    ``.port`` and in the ``[obs] serving`` log line."""
+
+    def __init__(self, obs, config, port: int, host: str = "127.0.0.1"):
+        self._httpd = _Server((host, port), _Handler)
+        self._httpd.obs = obs
+        self._httpd.config = config
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        self.url = f"http://{host}:{self.port}"
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="obs-serve")
+        self._stopped = False
+
+    def start(self) -> None:
+        self._thread.start()
+        _log.info("[obs] serving live telemetry on %s "
+                  "(/metrics /status /series)", self.url)
+        portfile = os.environ.get("MOXT_OBS_PORT_FILE")
+        if portfile:
+            # machine-readable port discovery for harnesses scraping an
+            # ephemeral-port job (scripts/check.sh, the Gloo tests): one
+            # appended "<process> <port>" line per serving process
+            try:
+                fd = os.open(portfile,
+                             os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+                try:
+                    os.write(fd, f"{self._httpd.obs.process} "
+                                 f"{self.port}\n".encode())
+                finally:
+                    os.close(fd)
+            except OSError as e:  # discovery is best-effort
+                _log.warning("cannot write MOXT_OBS_PORT_FILE %s: %s",
+                             portfile, e)
+
+    def stop(self) -> None:
+        """Idempotent clean shutdown (called by ``Obs.finish`` AND the
+        flight recorder — whichever runs first wins)."""
+        if self._stopped:
+            return
+        self._stopped = True
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except Exception as e:  # pragma: no cover - defensive
+            _log.debug("obs server shutdown: %s", e)
+
+
+def serve_port_for_process(obs_port: int, process: int) -> int:
+    """The port THIS process binds: ephemeral stays ephemeral; a fixed
+    port offsets by the process slot so co-hosted processes don't
+    collide."""
+    return 0 if obs_port == 0 else obs_port + process

@@ -1,0 +1,153 @@
+"""Ring-buffer time-series recorder: the metrics registry, over time.
+
+Everything the registry holds is a point-in-time aggregate — a counter's
+final value says nothing about WHEN the bytes moved, and a 30-minute
+streamed job is a flat line until ``Obs.finish``.  This module adds the
+time axis: a low-overhead sampler thread snapshots every counter, gauge,
+and histogram quantile (plus the live HBM gauges the device sampler
+maintains and the pipeline overlap ratio) at ``--obs-sample-interval``,
+into a bounded ring — old samples are overwritten, never appended
+without bound, so a week-long resident job (ROADMAP open item 2) holds a
+fixed telemetry footprint.
+
+Exports two ways:
+
+* the ``series`` section of the metrics document (version-stamped like
+  everything else in it): ``{"schema": "moxt-series-v1", "interval_s",
+  "t_unix_s": [...], "series": {name: [...]}}`` with per-name value
+  lists aligned to the timestamp list (``None`` where a series had not
+  started yet);
+* the live ``/series`` endpoint (:mod:`map_oxidize_tpu.obs.serve`),
+  same shape, readable mid-run under concurrent scrape.
+
+Overhead per tick is one locked dict copy of the registry (microseconds
+at the registry sizes jobs produce) on a daemon thread; the hot paths
+are untouched.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+SERIES_SCHEMA = "moxt-series-v1"
+
+#: ring capacity (samples): at the 1 s default interval this is ~17 min
+#: of history; longer jobs keep the most recent window, which is what a
+#: live view needs — the full-job aggregates are the registry's job
+DEFAULT_CAPACITY = 1024
+
+#: histogram stats carried per series sample
+_HIST_STATS = ("p50", "p95")
+
+
+class TimeSeriesRecorder:
+    """Samples one job's :class:`~map_oxidize_tpu.obs.metrics.
+    MetricsRegistry` into a bounded ring on a daemon thread.
+
+    ``interval_s`` is the tick; ``capacity`` bounds the ring.  ``clock``
+    is injectable for tests (the thread is optional — :meth:`sample_once`
+    is the whole tick and is public)."""
+
+    def __init__(self, registry, interval_s: float = 1.0,
+                 capacity: int = DEFAULT_CAPACITY, clock=time.time,
+                 heartbeat=None):
+        if interval_s <= 0:
+            raise ValueError("interval_s must be positive")
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self.registry = registry
+        #: optional heartbeat: its live row/byte progress becomes the
+        #: ``progress/rows`` / ``progress/bytes_done`` series
+        self.heartbeat = heartbeat
+        self.interval_s = interval_s
+        self.capacity = capacity
+        self._clock = clock
+        #: ring of (unix_ts, {name: value}) snapshots; _head is the next
+        #: write slot once the ring has wrapped
+        self._ring: list = []
+        self._head = 0
+        self.samples_taken = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="obs-timeseries")
+
+    # --- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        """Stop the thread and take one final sample so the exported
+        series always includes the job's end state (jobs shorter than one
+        interval still get a point)."""
+        self._stop.set()
+        self.sample_once()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.sample_once()
+
+    # --- sampling ---------------------------------------------------------
+
+    def _snapshot(self) -> dict:
+        """One flat {name: scalar} reading of the registry: counters and
+        numeric gauges by name, histograms as ``<name>/p50``/``p95`` and
+        ``<name>/count`` (the count series is what rate-of-progress reads
+        come from)."""
+        reg = self.registry
+        snap: dict = {}
+        with reg._lock:
+            for k, v in reg.counters.items():
+                snap[k] = v
+            for k, v in reg.gauges.items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    snap[k] = v
+            for k, h in reg.histograms.items():
+                snap[f"{k}/count"] = h.count
+                for stat in _HIST_STATS:
+                    q = h.quantile(0.50 if stat == "p50" else 0.95)
+                    if q is not None:
+                        snap[f"{k}/{stat}"] = q
+        hb = self.heartbeat
+        if hb is not None:
+            snap["progress/rows"] = hb.rows
+            if hb.bytes_done:
+                snap["progress/bytes_done"] = hb.bytes_done
+        return snap
+
+    def sample_once(self) -> None:
+        sample = (self._clock(), self._snapshot())
+        with self._lock:
+            if len(self._ring) < self.capacity:
+                self._ring.append(sample)
+            else:
+                self._ring[self._head] = sample
+                self._head = (self._head + 1) % self.capacity
+            self.samples_taken += 1
+
+    # --- export -----------------------------------------------------------
+
+    def export(self) -> dict:
+        """The ``series`` document: timestamps plus aligned per-name value
+        lists, oldest sample first.  Safe to call at any time (including
+        under concurrent ticks)."""
+        with self._lock:
+            ordered = self._ring[self._head:] + self._ring[:self._head]
+            samples_taken = self.samples_taken
+        t = [round(ts, 3) for ts, _ in ordered]
+        names: dict[str, None] = {}
+        for _ts, snap in ordered:
+            for k in snap:
+                names.setdefault(k)
+        series = {name: [snap.get(name) for _ts, snap in ordered]
+                  for name in names}
+        return {
+            "schema": SERIES_SCHEMA,
+            "interval_s": self.interval_s,
+            "capacity": self.capacity,
+            "samples_taken": samples_taken,
+            "t_unix_s": t,
+            "series": series,
+        }

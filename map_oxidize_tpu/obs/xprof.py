@@ -342,9 +342,14 @@ class DeviceSampler:
                 f"(median {med:.2f}s, factor {self.stall_factor:g}); "
                 f"open spans: {open_s}")
         hb = self.obs.heartbeat
-        if hb is not None:
+        if hb is not None and not getattr(hb, "silent", False):
             hb._emit(line)
         else:
+            # no heartbeat, or a silent tracking-only one (the live
+            # plane's /status feed): the warning must still hit the log
             _log.warning("%s", line)
-        self.obs.registry.count("stall_warnings")
+        # the counter the ledger gate and /status read: a stall episode
+        # is evidence, not just a log line (any increase vs the previous
+        # comparable run flags in `obs diff --gate`)
+        self.obs.registry.count("heartbeat/stalls")
         return True

@@ -301,6 +301,9 @@ class ShardedCollectEngine:
             self._ensure_room()
             batch = tuple(jax.device_put(x, self._row_spec)
                           for x in (p_hi, p_lo, p_dhi, p_dlo))
+            import time as _time
+
+            t0 = _time.perf_counter()
             *state, ovf = self._route_append(*self._buf, self._cursor,
                                              *batch)
             self._buf = tuple(state[:4])
@@ -308,17 +311,27 @@ class ShardedCollectEngine:
             # worst case every live row landed on one shard
             self._cursor_ub += min(n, self.block)
             self._overflows.append(ovf)
-            if self.obs is not None:
-                from map_oxidize_tpu.parallel.shuffle import (
-                    exchange_payload_bytes,
-                )
+            self._record_exchange(n, t0, ovf)
 
-                self.obs.registry.count("shuffle/exchanges")
-                self.obs.registry.count("shuffle/rows_exchanged", n)
-                # doc planes ride as an 8-byte value row (dhi, dlo)
-                self.obs.registry.count(
-                    "shuffle/all_to_all_bytes",
-                    exchange_payload_bytes(S, self.bucket_cap, 8))
+    def _record_exchange(self, n: int, t0: float, ovf) -> None:
+        """Shuffle counters + comms-observatory row for one route_append
+        (shared with the multi-process subclass's lockstep feed).  Doc
+        planes ride as an 8-byte value row (dhi, dlo); latency is
+        sampled on the xprof cadence by forcing the tiny replicated
+        overflow scalar."""
+        if self.obs is None:
+            return
+        from map_oxidize_tpu.obs.metrics import sample_collective_wall
+        from map_oxidize_tpu.parallel.shuffle import exchange_payload_bytes
+
+        reg = self.obs.registry
+        payload = exchange_payload_bytes(self.S, self.bucket_cap, 8)
+        reg.count("shuffle/exchanges")
+        reg.count("shuffle/rows_exchanged", n)
+        reg.count("shuffle/all_to_all_bytes", payload)
+        lat_ms = sample_collective_wall(self, "_n_appends", t0, ovf)
+        reg.comm("all_to_all", "collect/route_append", payload,
+                 shape=(self.S, self.bucket_cap), latency_ms=lat_ms)
 
     def finalize(self):
         """Route + sort everything fed; returns host ``(keys_u64, docs_i64)``

@@ -274,12 +274,16 @@ class DistributedCollectEngine(ShardedCollectEngineBase):
         batch = tuple(
             jax.make_array_from_process_local_data(self._row_spec, x, (B,))
             for x in planes)
+        import time as _time
+
+        t0 = _time.perf_counter()
         *state, ovf = self._route_append(*self._buf, self._cursor, *batch)
         self._buf = tuple(state[:4])
         self._cursor = state[4]
         # worst case: every live row in the global batch landed on one shard
         self._cursor_ub += self.block
         self._overflows.append(ovf)
+        self._record_exchange(n, t0, ovf)
 
     def feed(self, out):  # pragma: no cover - contract guard
         raise NotImplementedError(
@@ -303,17 +307,35 @@ def _make_flag_sum(mesh):
 
 def _any_remaining(engine, i_have_rows: bool) -> bool:
     """Global OR over processes (one tiny mesh psum): does anyone still
-    have rows?  Every process must call this once per round."""
+    have rows?  Every process must call this once per round.
+
+    The round is host-synchronous (``np.asarray`` forces the psum), so
+    its wall IS the collective's latency — recorded per invocation into
+    the comms observatory and the ``dist/flag_wait_ms`` histogram, the
+    live straggler-wait signal process 0's ``/status`` aggregate reads
+    (a fast process's flag wall is time blocked on the slowest one)."""
+    import time as _time
+
     import jax
 
     S = engine.S
     local = np.full(S // engine.n_proc, 1 if i_have_rows else 0, np.int32)
     flags = jax.make_array_from_process_local_data(
         engine._sharding, local, (S,))
-    return int(np.asarray(engine._flag_sum(flags))) > 0
+    t0 = _time.perf_counter()
+    out = int(np.asarray(engine._flag_sum(flags))) > 0
+    obs = engine.obs
+    if obs is not None:
+        wall_ms = (_time.perf_counter() - t0) * 1e3
+        obs.registry.observe("dist/flag_wait_ms", wall_ms)
+        # payload: the [S] int32 flag vector, summed to every shard
+        obs.registry.comm("psum", "dist/flag_psum", 4 * S * S,
+                          shape=(S,), latency_ms=wall_ms)
+    return out
 
 
-def gather_strings(hashes: "list[int]", dictionary) -> "dict[int, bytes]":
+def gather_strings(hashes: "list[int]", dictionary,
+                   obs=None) -> "dict[int, bytes]":
     """Resolve key bytes for ``hashes`` across every process: each process
     contributes what its local dictionary knows, gathered THROUGH the mesh
     (``process_allgather`` — no shared filesystem, no RPC side-channel).
@@ -322,7 +344,11 @@ def gather_strings(hashes: "list[int]", dictionary) -> "dict[int, bytes]":
     abort (a cross-process 64-bit collision — same guarantee the
     single-process dictionary gives).  Returns possibly-partial results:
     a hash nobody can resolve is simply absent.  Every process must call
-    this with the SAME hash list (it is a collective)."""
+    this with the SAME hash list (it is a collective).  With ``obs``,
+    both rounds land in the comms observatory (payload + measured wall —
+    the call is host-synchronous, so the wall IS the latency)."""
+    import time as _time
+
     from jax.experimental import multihost_utils
 
     k = len(hashes)
@@ -334,9 +360,15 @@ def gather_strings(hashes: "list[int]", dictionary) -> "dict[int, bytes]":
     # unknown-here), so a zero-length key resolves to b"" instead of
     # silently reporting unresolvable
     lens = np.array([-1 if b is None else len(b) for b in local], np.int32)
+    t0 = _time.perf_counter()
     all_lens = np.asarray(multihost_utils.process_allgather(lens))
     if all_lens.ndim == 1:  # single process: allgather returns (k,)
         all_lens = all_lens[None]
+    if obs is not None:
+        P = all_lens.shape[0]
+        obs.registry.comm("all_gather", "dist/gather_strings",
+                          P * P * lens.nbytes, shape=lens.shape,
+                          latency_ms=(_time.perf_counter() - t0) * 1e3)
     maxlen = int(all_lens.max())
     if maxlen < 0:
         return {}
@@ -344,9 +376,15 @@ def gather_strings(hashes: "list[int]", dictionary) -> "dict[int, bytes]":
     for i, b in enumerate(local):
         if b is not None and b:
             buf[i, :len(b)] = np.frombuffer(b, np.uint8)
+    t0 = _time.perf_counter()
     all_buf = np.asarray(multihost_utils.process_allgather(buf))
     if all_buf.ndim == 2:
         all_buf = all_buf[None]
+    if obs is not None:
+        P = all_buf.shape[0]
+        obs.registry.comm("all_gather", "dist/gather_strings",
+                          P * P * buf.nbytes, shape=buf.shape,
+                          latency_ms=(_time.perf_counter() - t0) * 1e3)
     out: dict[int, bytes] = {}
     for i, h in enumerate(hashes):
         for p in range(all_lens.shape[0]):
@@ -363,7 +401,7 @@ def gather_strings(hashes: "list[int]", dictionary) -> "dict[int, bytes]":
     return out
 
 
-def _allgather_union(local: np.ndarray) -> np.ndarray:
+def _allgather_union(local: np.ndarray, obs=None) -> np.ndarray:
     """Global sorted-unique union of each process's u64 hash list (two
     allgather rounds: counts, then zero-padded planes).  The result is
     identical on every process, so it can feed :func:`gather_strings`
@@ -381,6 +419,8 @@ def _allgather_union(local: np.ndarray) -> np.ndarray:
         g = np.asarray(multihost_utils.process_allgather(a))
         return g[None] if g.ndim == a.ndim else g
 
+    import time as _time
+
     local = np.asarray(local, np.uint64)
     all_n = _ag(np.array([local.shape[0]], np.int32)).reshape(-1)
     cap = int(all_n.max()) if all_n.size else 0
@@ -390,15 +430,21 @@ def _allgather_union(local: np.ndarray) -> np.ndarray:
     hi, lo = split_u64(local)
     pad[0, :local.shape[0]] = hi
     pad[1, :local.shape[0]] = lo
+    t0 = _time.perf_counter()
     planes = _ag(pad)
+    if obs is not None:
+        P = planes.shape[0]
+        obs.registry.comm("all_gather", "dist/hash_union",
+                          P * P * pad.nbytes, shape=pad.shape,
+                          latency_ms=(_time.perf_counter() - t0) * 1e3)
     parts = [join_u64(planes[i, 0, :int(all_n[i])],
                       planes[i, 1, :int(all_n[i])])
              for i in range(planes.shape[0])]
     return np.unique(np.concatenate(parts))
 
 
-def partition_strings(hashes, dictionary, proc: int, n_proc: int
-                      ) -> "dict[int, bytes]":
+def partition_strings(hashes, dictionary, proc: int, n_proc: int,
+                      obs=None) -> "dict[int, bytes]":
     """Resolve key bytes for THIS process's hash partition
     (``h % n_proc == proc``) of ``hashes``.  Local dictionary first; the
     union of every process's misses resolves through one
@@ -409,7 +455,7 @@ def partition_strings(hashes, dictionary, proc: int, n_proc: int
     d = dictionary.materialized()
     missing = np.array([h for h in owned if h not in d], np.uint64)
     gathered = gather_strings(
-        [int(h) for h in _allgather_union(missing)], dictionary)
+        [int(h) for h in _allgather_union(missing, obs)], dictionary, obs)
     out: dict[int, bytes] = {}
     for h in owned:
         b = d.get(h)
@@ -666,7 +712,7 @@ def _run_distributed_core(config: JobConfig, workload: str, obs: Obs
             bounds = np.empty(0, np.int64)
         order = np.lexsort((uniq, -df))[:config.top_k]
         t_hashes = uniq[order].tolist()
-        words = gather_strings(t_hashes, dictionary)
+        words = gather_strings(t_hashes, dictionary, obs)
         top = [(h, words.get(h), int(df[order][j]))
                for j, h in enumerate(t_hashes)]
         if config.output_path:
@@ -678,7 +724,7 @@ def _run_distributed_core(config: JobConfig, workload: str, obs: Obs
 
             with obs.phase("write"):
                 names = partition_strings(uniq.tolist(), dictionary,
-                                          engine.proc, P_)
+                                          engine.proc, P_, obs)
                 ends = np.append(bounds, keys.shape[0])
                 owned = sorted(
                     (names[int(h)], j) for j, h in enumerate(uniq.tolist())
@@ -714,7 +760,7 @@ def _run_distributed_core(config: JobConfig, workload: str, obs: Obs
         t64 = join_u64(t_hi, t_lo)
         tlive = t64 != np.uint64(0xFFFFFFFFFFFFFFFF)
         t_hashes = t64[tlive].tolist()
-        words = gather_strings(t_hashes, dictionary)
+        words = gather_strings(t_hashes, dictionary, obs)
         top = [(h, words.get(h), c)
                for h, c in zip(t_hashes, t_vals[tlive].tolist())]
         if config.output_path:
@@ -722,7 +768,7 @@ def _run_distributed_core(config: JobConfig, workload: str, obs: Obs
 
             with obs.phase("write"):
                 names = partition_strings(list(counts), dictionary,
-                                          engine.proc, P_)
+                                          engine.proc, P_, obs)
                 write_final_result(
                     partition_output_path(config.output_path, engine.proc,
                                           P_),
@@ -765,6 +811,7 @@ def finish_distributed_obs(obs: Obs, config: JobConfig, workload: str
         sample_host_memory,
     )
 
+    obs.stop_live()
     xprof_report = obs.finish_xprof()
     sample_host_memory(obs.registry)
     sample_device_memory(obs.registry)
@@ -777,6 +824,8 @@ def finish_distributed_obs(obs: Obs, config: JobConfig, workload: str
         # per-process xprof shards merge like everything else: each
         # process's metrics doc carries its own program table
         metrics_doc["xprof"] = xprof_report
+    if obs.series is not None:
+        metrics_doc["series"] = obs.series.export()
     if config.metrics_out:
         # one document per process (counters are per-process facts); the
         # suffix keeps P writers off one file
@@ -816,6 +865,9 @@ def finish_distributed_obs(obs: Obs, config: JobConfig, workload: str
         if skew:
             extra = {"records_total": skew.get("records_total"),
                      "skew": skew.get("skew")}
+        comms = obs.registry.comms_table()
+        if comms:
+            extra["comms"] = comms
         ledger.append(config.ledger_dir, ledger.build_entry(
             config, workload, summary, n_processes=P_, extra=extra))
     return summary, trace
@@ -873,9 +925,17 @@ def _run_distributed_distinct(config: JobConfig, obs: Obs
                 obs.heartbeat.update(rows=out.records_in,
                                      bytes_done=base + len(chunk))
     with obs.phase("finalize"):
+        import time as _time
+
+        t0 = _time.perf_counter()
         all_regs = np.asarray(multihost_utils.process_allgather(registers))
         if all_regs.ndim == 1:
             all_regs = all_regs[None]
+        obs.registry.comm(
+            "all_gather", "dist/hll_registers",
+            all_regs.shape[0] ** 2 * registers.nbytes,
+            shape=registers.shape,
+            latency_ms=(_time.perf_counter() - t0) * 1e3)
         merged = all_regs.max(axis=0).astype(np.int32)
         est = hll_estimate(merged)
     if config.output_path and proc == 0:
@@ -1060,6 +1120,11 @@ def _run_distributed_kmeans(config: JobConfig, obs: Obs
         with obs.phase("write"):
             write_centroids(config.output_path, out)
     ran_iters = max(remaining, 0)
+    # comms accounting: one (k, d+1) partial-sums psum per iteration run
+    # (the only cross-process traffic of the fit — centroids, not points)
+    for _ in range(ran_iters):
+        obs.registry.comm("psum", "kmeans/fit_sharded",
+                          S * k * (d + 1) * 4, shape=(k, d + 1))
     if store is not None and proc == 0:
         # a zero-work run only READ the continue-training state; deleting
         # its snapshot then would destroy progress (single-controller

@@ -121,6 +121,9 @@ class ShardedReduceEngine(StreamingEngineBase):
         self._ensure_capacity(incoming)
         if count_rows:
             self.rows_fed += hi.shape[0]
+        import time as _time
+
+        t0 = _time.perf_counter()
         *self._acc, self._n_unique, self._overflow = self._merge(
             *self._acc, self._overflow, hi, lo, vals
         )
@@ -133,14 +136,24 @@ class ShardedReduceEngine(StreamingEngineBase):
             reg = self.obs.registry
             reg.count("shuffle/exchanges")
             reg.count("shuffle/rows_exchanged", hi.shape[0])
-            reg.count("shuffle/all_to_all_bytes", exchange_payload_bytes(
+            payload = exchange_payload_bytes(
                 self.S, self.bucket_cap,
                 int(self.value_dtype.itemsize
                     * max(1, int(np.prod(self.value_shape, dtype=np.int64)))
-                    )))
+                    ))
+            reg.count("shuffle/all_to_all_bytes", payload)
             # the per-merge psum payloads: the [S] unique counts + the [S]
             # overflow counter, int32 each, replicated over S shards
-            reg.count("shuffle/psum_bytes", 2 * 4 * self.S * self.S)
+            psum_payload = 2 * 4 * self.S * self.S
+            reg.count("shuffle/psum_bytes", psum_payload)
+            from map_oxidize_tpu.obs.metrics import sample_collective_wall
+
+            lat_ms = sample_collective_wall(self, "_exchanges", t0,
+                                            self._overflow)
+            reg.comm("all_to_all", "shuffle/merge", payload,
+                     shape=(self.S, self.bucket_cap), latency_ms=lat_ms)
+            reg.comm("psum", "shuffle/merge", psum_payload,
+                     shape=(self.S,))
 
     def export_state(self) -> dict:
         """Host snapshot of the sharded reduce state (see the single-device
@@ -184,4 +197,15 @@ class ShardedReduceEngine(StreamingEngineBase):
         return (*self._acc, int(np.sum(np.asarray(self._n_unique))))
 
     def _top_k_device(self, k: int):
-        return self._topk(*self._acc, k)
+        out = self._topk(*self._acc, k)
+        if self.obs is not None:
+            # two-level top-k moves S*k_local candidate rows per shard
+            # over the all_gather (hi+lo planes plus the value column)
+            k_local = min(k, self.capacity)
+            vbytes = int(self.value_dtype.itemsize * max(
+                1, int(np.prod(self.value_shape, dtype=np.int64))))
+            self.obs.registry.comm(
+                "all_gather", "shuffle/top_k",
+                self.S * self.S * k_local * (8 + vbytes),
+                shape=(self.S, k_local))
+        return out

@@ -141,7 +141,7 @@ def kmeans_fit_streamed(path: str, centroids, iters: int = 1,
                         num_shards: int = 0, backend: str = "auto",
                         device=None, precision: str = "highest",
                         timings: dict | None = None, on_iter=None,
-                        pipeline_depth: int = 2):
+                        pipeline_depth: int = 2, obs=None):
     """Beyond-HBM k-means THROUGH the mesh (SURVEY §7 hard part (c) as
     prescribed: streaming *through the mesh*, not through one chip):
     fixed-row chunks from a memory-mapped ``.npy`` stream as per-shard
@@ -232,6 +232,13 @@ def kmeans_fit_streamed(path: str, centroids, iters: int = 1,
             b_dev = jax.device_put(block, row)  # async: overlaps compute
             out = step(b_dev, w, c_dev, acc,
                        j == 0, j == len(starts) - 1)
+            if obs is not None and S > 1:
+                # comms observatory: the one (k, d+1) partials psum each
+                # chunk step pays (accounting identity; latency rides in
+                # the xprof device samples of kmeans/stream_step; on a
+                # 1-device mesh the psum degenerates and moves nothing)
+                obs.registry.comm("psum", "kmeans/stream_step",
+                                  S * k * (d + 1) * 4, shape=(k, d + 1))
             if j == len(starts) - 1:
                 c_dev = out
             else:
@@ -256,7 +263,7 @@ def kmeans_fit_streamed(path: str, centroids, iters: int = 1,
 def kmeans_fit_sharded(points, centroids, iters: int = 1, mesh=None,
                        num_shards: int = 0, backend: str = "auto",
                        on_iter=None, timings: dict | None = None,
-                       precision: str = "highest"):
+                       precision: str = "highest", obs=None):
     """Run ``iters`` k-means iterations with points sharded over the mesh.
 
     ``points``: host ``(n, d)`` float32 (rows pad to a multiple of the shard
@@ -306,6 +313,12 @@ def kmeans_fit_sharded(points, centroids, iters: int = 1, mesh=None,
     if timings is not None:
         timings["transfer_s"] = time.perf_counter() - t0
     c_dev = jax.device_put(centroids, rep)
+    if obs is not None and S > 1:
+        # one (k, d+1) partials psum per iteration — the fit's only
+        # collective (centroids move, points never do)
+        for _ in range(iters):
+            obs.registry.comm("psum", "kmeans/fit_sharded",
+                              S * k * (d + 1) * 4, shape=(k, d + 1))
     t0 = time.perf_counter()
     if on_iter is None:
         out = np.asarray(fit_fn(p_dev, w_dev, c_dev))  # asarray forces

@@ -865,7 +865,7 @@ def _run_kmeans_body(config: JobConfig, obs: Obs,
             kw = dict(iters=remaining, chunk_rows=chunk_rows,
                       precision=config.kmeans_precision, timings=timings,
                       on_iter=_iter_done if want_iter_cb else None,
-                      pipeline_depth=config.pipeline_depth)
+                      pipeline_depth=config.pipeline_depth, obs=obs)
             if n_shards > 1:
                 # streaming x sharding composed: each chunk's put splits
                 # across the mesh and the step is the shared one-psum
@@ -897,7 +897,8 @@ def _run_kmeans_body(config: JobConfig, obs: Obs,
                     np.asarray(pts, np.float32), centroids,
                     iters=remaining, num_shards=config.num_shards,
                     backend=config.backend, on_iter=on_iter,
-                    timings=timings, precision=config.kmeans_precision)
+                    timings=timings, precision=config.kmeans_precision,
+                    obs=obs)
                 for tk, tv in timings.items():
                     metrics.set(f"time/{tk}", round(tv, 4))
             else:

@@ -163,7 +163,16 @@ def pipelined(it: Iterable[T], depth: int, obs=None,
 
     def _run():
         try:
-            yield from pf
+            for item in pf:
+                if obs is not None:
+                    # live overlap gauge: the time-series recorder and
+                    # /status read it MID-run (the exhaustion-time
+                    # counters below stay the post-hoc record); one
+                    # locked gauge write per chunk is noise at chunk
+                    # cadence
+                    obs.registry.set("pipeline/overlap_ratio",
+                                     round(pf.overlap_ratio, 4))
+                yield item
         finally:
             if obs is not None and (pf.items or pf.produce_s):
                 reg = obs.registry

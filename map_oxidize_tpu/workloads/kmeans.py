@@ -312,7 +312,7 @@ def kmeans_fit_streamed_device(path: str, centroids: np.ndarray,
                                iters: int = 1, chunk_rows: int = 1 << 21,
                                device=None, precision: str = "highest",
                                timings: dict | None = None, on_iter=None,
-                               pipeline_depth: int = 2):
+                               pipeline_depth: int = 2, obs=None):
     """Beyond-HBM k-means with DEVICE assignment: points stream through
     the chip in fixed-row chunks each iteration — SURVEY §7 hard part
     (c)'s double-buffered formulation, now the 1-device mesh case of
@@ -358,7 +358,7 @@ def kmeans_fit_streamed_device(path: str, centroids: np.ndarray,
                                chunk_rows=chunk_rows, device=device,
                                precision=precision, timings=timings,
                                on_iter=on_iter,
-                               pipeline_depth=pipeline_depth)
+                               pipeline_depth=pipeline_depth, obs=obs)
 
 
 def write_centroids(path: str, centroids: np.ndarray) -> None:

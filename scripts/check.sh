@@ -70,4 +70,91 @@ python -m map_oxidize_tpu obs xprof "$smoke/metrics.json" | head -5
 python -m map_oxidize_tpu obs diff --ledger-dir "$smoke/ledger"
 python -m map_oxidize_tpu obs diff --ledger-dir "$smoke/ledger" \
     --gate -- -1 -1
+
+echo "== live telemetry smoke =="
+# a big-enough HIGH-CARDINALITY corpus (the native mapper pre-combines
+# per chunk, so a repeated-words corpus stages too few rows to flush
+# mid-run) and an 8-virtual-device mesh so the run has real collectives
+# to observe while it is still running
+python - "$smoke" <<'EOF'
+import sys
+with open(f"{sys.argv[1]}/corpus_live.txt", "wb") as f:
+    for i in range(6000):
+        f.write((" ".join(f"w{i * 8 + j}" for j in range(8))
+                 + "\n").encode())
+EOF
+export MOXT_OBS_PORT_FILE="$smoke/ports.txt"
+rm -f "$smoke/ports.txt"
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python -m map_oxidize_tpu wordcount "$smoke/corpus_live.txt" \
+    --output "$smoke/out_live.txt" --num-shards 8 --num-chunks 48 \
+    --batch-size 512 --quiet --obs-port 0 \
+    --metrics-out "$smoke/metrics_live.json" > /dev/null &
+live_job=$!
+python - "$smoke" <<'EOF'
+import json, os, sys, time, urllib.request
+d = sys.argv[1]
+deadline = time.monotonic() + 180
+port = None
+while time.monotonic() < deadline and port is None:
+    try:
+        port = int(open(f"{d}/ports.txt").read().split()[1])
+    except (OSError, IndexError, ValueError):
+        time.sleep(0.01)
+assert port, "obs server port never appeared in MOXT_OBS_PORT_FILE"
+url = f"http://127.0.0.1:{port}"
+
+def get(ep):
+    return urllib.request.urlopen(url + ep, timeout=5).read()
+
+# /metrics and /series are valid from server start: grab them first,
+# then keep polling /status until one scrape shows an open phase AND a
+# populated comms table (accumulated across scrapes — the server going
+# away means the job ended, and by then the evidence must be in hand)
+prom = series = None
+phase_seen = comms_seen = None
+connected = fails = 0
+while time.monotonic() < deadline:
+    try:
+        if prom is None:
+            p = get("/metrics").decode()
+            if "# TYPE" in p:  # skip the registry's pre-job empty state
+                prom = p
+        if series is None:
+            series = json.loads(get("/series"))
+        s = json.loads(get("/status"))
+        connected, fails = 1, 0
+    except OSError:
+        fails += 1
+        if connected and fails > 200:
+            break  # server gone for ~2s = job done; stop polling
+        time.sleep(0.01)
+        continue
+    assert s["schema"] == "moxt-status-v1"
+    assert s["meta"]["workload"] == "wordcount"
+    if s.get("phase"):
+        phase_seen = s["phase"]
+    if s.get("comms"):
+        comms_seen = s["comms"]
+    if phase_seen and comms_seen:
+        break
+    time.sleep(0.01)
+assert phase_seen, "never scraped a mid-run /status with an open phase"
+assert comms_seen, "never scraped a /status with a comms table"
+assert any(r["collective"] == "all_to_all" for r in comms_seen)
+assert prom and "# TYPE" in prom and "moxt_" in prom, "bad /metrics"
+assert series and series["schema"] == "moxt-series-v1"
+print(f"live scrape OK mid-run: phase={phase_seen} "
+      f"comms_rows={len(comms_seen)}")
+EOF
+wait "$live_job"
+unset MOXT_OBS_PORT_FILE
+python - "$smoke" <<'EOF'
+import json, sys
+m = json.load(open(f"{sys.argv[1]}/metrics_live.json"))
+assert m["series"]["schema"] == "moxt-series-v1", "series section missing"
+assert any(r["program"] == "shuffle/merge" for r in m["comms"]), \
+    "comms table missing from the metrics document"
+print("final metrics doc carries series + comms tables")
+EOF
 echo "check.sh: ALL OK"

@@ -1,0 +1,646 @@
+"""Live telemetry plane (ISSUE-6 tentpole): the time-series ring, the
+/metrics + /status + /series server, the comms observatory, per-job
+ObsContext isolation, and the comms/stall ledger gates.
+
+The single-controller tests drive REAL jobs (a deliberately slowed
+mapper keeps the scrape window open deterministically); the 2-process
+Gloo test launches real processes and scrapes both per-process servers
+mid-run, port-discovered through ``MOXT_OBS_PORT_FILE``.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from map_oxidize_tpu.config import JobConfig
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _get(url: str, timeout: float = 10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read()
+
+
+def _get_json(url: str) -> dict:
+    return json.loads(_get(url))
+
+
+def _write_corpus(path, lines: int = 400) -> int:
+    words = [b"alpha", b"beta", b"gamma", b"delta", b"epsilon", b"zeta"]
+    rng = np.random.default_rng(7)
+    with open(path, "wb") as f:
+        for _ in range(lines):
+            f.write(b" ".join(words[int(i)]
+                              for i in rng.integers(0, 6, 8)) + b"\n")
+    return os.path.getsize(path)
+
+
+class _SlowMapper:
+    """Delegating mapper that sleeps per chunk: holds a real job open so
+    mid-run scrapes are deterministic, output identical to the inner
+    mapper's."""
+
+    def __init__(self, inner, delay_s: float):
+        self._inner = inner
+        self._delay = delay_s
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def map_chunk(self, chunk):
+        time.sleep(self._delay)
+        return self._inner.map_chunk(chunk)
+
+
+# --- single-controller: endpoints during a real job ------------------------
+
+
+@pytest.fixture(scope="module")
+def live_job(tmp_path_factory):
+    """One slowed wordcount run with the live plane on: scraped /status,
+    /metrics, and /series documents captured MID-run, plus the job's
+    result and final metrics document."""
+    from map_oxidize_tpu.runtime.driver import run_wordcount_job
+    from map_oxidize_tpu.workloads.wordcount import make_wordcount
+
+    tmp = tmp_path_factory.mktemp("live")
+    corpus = tmp / "c.txt"
+    _write_corpus(corpus)
+    mapper, reducer = make_wordcount("ascii", use_native=False)
+    cfg = JobConfig(
+        input_path=str(corpus), output_path="", metrics=False,
+        num_chunks=10, batch_size=1 << 12, key_capacity=1 << 12,
+        num_map_workers=1,  # serialize the slowed chunks: a ~1.5s window
+        mapper="python", use_native=False,
+        obs_port=0, obs_sample_s=0.02, trace_out="-",
+        metrics_out=str(tmp / "metrics.json"),
+    )
+    portfile = tmp / "ports.txt"
+    os.environ["MOXT_OBS_PORT_FILE"] = str(portfile)
+    box: dict = {}
+
+    def _run():
+        try:
+            box["result"] = run_wordcount_job(
+                cfg, _SlowMapper(mapper, 0.15), reducer)
+        except BaseException as e:  # pragma: no cover - surfaced below
+            box["error"] = e
+
+    t = threading.Thread(target=_run)
+    t.start()
+    try:
+        deadline = time.monotonic() + 60
+        while not portfile.exists() and time.monotonic() < deadline:
+            time.sleep(0.005)
+        port = int(portfile.read_text().split()[1])
+        url = f"http://127.0.0.1:{port}"
+        # poll until the job is demonstrably mid-run (a phase is open)
+        status = None
+        while time.monotonic() < deadline:
+            status = _get_json(url + "/status")
+            if status.get("phase") == "map+reduce":
+                break
+            time.sleep(0.01)
+        scrapes = {
+            "status": status,
+            "metrics": _get(url + "/metrics").decode(),
+            "series": _get_json(url + "/series"),
+            "index": _get_json(url + "/"),
+        }
+        # a second status a few chunks later must show progress moved
+        time.sleep(0.4)
+        scrapes["status2"] = _get_json(url + "/status")
+    finally:
+        t.join(timeout=120)
+        os.environ.pop("MOXT_OBS_PORT_FILE", None)
+    if "error" in box:
+        raise box["error"]
+    assert not t.is_alive()
+    return cfg, box["result"], scrapes, url, tmp
+
+
+def test_status_schema_mid_run(live_job):
+    _cfg, _result, scrapes, _url, _tmp = live_job
+    s = scrapes["status"]
+    assert s["schema"] == "moxt-status-v1"
+    assert s["phase"] == "map+reduce"
+    assert s["meta"]["workload"] == "wordcount"
+    assert s["meta"]["version"] and s["meta"]["config_hash"]
+    assert s["elapsed_s"] > 0
+    assert isinstance(s["comms"], list)  # single shard: present, empty
+    assert "open_spans" in s  # tracing was on
+    assert "xprof" in s  # live compile/MFU table
+    # progress comes from the silent heartbeat (no --progress flag!)
+    assert s["progress"]["rows"] >= 0
+    assert "fraction" in s["progress"]
+
+
+def test_status_updates_mid_run(live_job):
+    _cfg, result, scrapes, _url, _tmp = live_job
+    s1, s2 = scrapes["status"], scrapes["status2"]
+    assert s2["t_unix_s"] > s1["t_unix_s"]
+    assert s2["progress"]["rows"] >= s1["progress"]["rows"]
+    # by the later scrape some chunks were mapped
+    assert s2["progress"]["rows"] > 0
+    assert s2["progress"]["rows"] <= sum(result.counts.values())
+
+
+def test_prometheus_text_mid_run(live_job):
+    _cfg, _result, scrapes, _url, _tmp = live_job
+    text = scrapes["metrics"]
+    assert "# TYPE" in text
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name = line.split("{")[0].split(" ")[0]
+        assert name.startswith("moxt_")
+        # the Prometheus charset, post-sanitization
+        assert all(c.isalnum() or c in "_:" for c in name), name
+        float(line.rsplit(" ", 1)[1])  # every sample parses
+
+
+def test_series_schema_and_final_doc(live_job):
+    cfg, _result, scrapes, _url, tmp = live_job
+    live = scrapes["series"]
+    assert live["schema"] == "moxt-series-v1"
+    assert live["interval_s"] == pytest.approx(0.02)
+    # final metrics document carries the full series section
+    doc = json.loads((tmp / "metrics.json").read_text())
+    series = doc["series"]
+    assert series["schema"] == "moxt-series-v1"
+    t = series["t_unix_s"]
+    assert len(t) >= 2 and t == sorted(t)
+    assert series["samples_taken"] >= len(t)
+    # every series aligns with the timestamp axis
+    for name, vals in series["series"].items():
+        assert len(vals) == len(t), name
+    # the ring saw the feed-loop histograms and the heartbeat progress
+    assert any(k.startswith("feed_block_ms") for k in series["series"])
+    assert "progress/rows" in series["series"]
+    assert doc["meta"]["version"]  # stamped like everything else
+
+
+def test_server_down_after_finish(live_job):
+    _cfg, _result, _scrapes, url, _tmp = live_job
+    with pytest.raises((urllib.error.URLError, OSError)):
+        _get(url + "/status", timeout=2)
+
+
+def test_zero_compile_delta_from_live_plane(live_job):
+    """The telemetry plane must not change what compiles: the slowed
+    live-plane run compiles exactly what an identical dark run does."""
+    cfg, result, _scrapes, _url, tmp = live_job
+    from map_oxidize_tpu.runtime.driver import run_wordcount_job
+    from map_oxidize_tpu.workloads.wordcount import make_wordcount
+
+    mapper, reducer = make_wordcount("ascii", use_native=False)
+    import dataclasses
+
+    dark = dataclasses.replace(
+        cfg, obs_port=-1, obs_sample_s=0.0, trace_out=None,
+        metrics_out=None)
+    r2 = run_wordcount_job(dark, mapper, reducer)
+    live_compiles = {k: v for k, v in result.metrics.items()
+                     if k.startswith("compile/") and k.endswith("/compiles")}
+    dark_compiles = {k: v for k, v in r2.metrics.items()
+                     if k.startswith("compile/") and k.endswith("/compiles")}
+    # same program set; the dark run (second in the process) may compile
+    # FEWER (jit caches are warm) but never different programs, and the
+    # live run must not add any program the dark run doesn't know
+    assert set(live_compiles) == set(dark_compiles)
+    assert dict(r2.counts) == dict(result.counts)
+
+
+# --- concurrent scrape safety ----------------------------------------------
+
+
+def test_concurrent_scrape_safety(tmp_path):
+    """Hammer all three endpoints from threads while counters/histograms
+    churn: every response parses, none 500s, the server survives."""
+    from map_oxidize_tpu.obs import Obs
+
+    cfg = JobConfig(input_path=str(tmp_path / "x"), obs_port=0,
+                    obs_sample_s=0.01).validate()
+    obs = Obs.from_config(cfg)
+    stop = threading.Event()
+
+    def _churn():
+        i = 0
+        while not stop.is_set():
+            obs.registry.count("churn/counter", 1)
+            obs.registry.observe("churn/hist_ms", i % 17)
+            obs.registry.comm("psum", "churn/prog", 1024, shape=(8,),
+                              latency_ms=0.5)
+            i += 1
+
+    churner = threading.Thread(target=_churn, daemon=True)
+    churner.start()
+    errors: list = []
+    url = obs.server.url
+
+    def _scrape(ep):
+        try:
+            for _ in range(50):
+                body = _get(url + ep)
+                if ep != "/metrics":
+                    doc = json.loads(body)
+                    assert "error" not in doc
+        except Exception as e:
+            errors.append((ep, e))
+
+    threads = [threading.Thread(target=_scrape, args=(ep,))
+               for ep in ("/metrics", "/status", "/series") for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    stop.set()
+    churner.join(timeout=10)
+    obs.stop_live()
+    obs.finish_xprof()
+    assert not errors, errors
+
+
+# --- ring-buffer bounds ----------------------------------------------------
+
+
+def test_ring_buffer_bounds():
+    from map_oxidize_tpu.obs.metrics import MetricsRegistry
+    from map_oxidize_tpu.obs.timeseries import TimeSeriesRecorder
+
+    reg = MetricsRegistry()
+    ticks = iter(range(1000))
+    tsr = TimeSeriesRecorder(reg, interval_s=1.0, capacity=8,
+                             clock=lambda: float(next(ticks)))
+    for i in range(20):
+        reg.count("c", 1)
+        tsr.sample_once()
+    out = tsr.export()
+    assert out["samples_taken"] == 20
+    assert len(out["t_unix_s"]) == 8  # bounded: ring, not append
+    # the ring holds the LAST 8 samples, oldest first
+    assert out["t_unix_s"] == [float(i) for i in range(12, 20)]
+    assert out["series"]["c"] == [float(i) for i in range(13, 21)]
+
+
+# --- flight-recorder path --------------------------------------------------
+
+
+def test_live_plane_shutdown_on_abort(tmp_path):
+    """An aborting job stops the sampler thread AND the server (flight
+    path), and the crash bundle carries the series ring."""
+    from map_oxidize_tpu.obs import Obs
+
+    cfg = JobConfig(input_path=str(tmp_path / "x"), obs_port=0,
+                    obs_sample_s=0.01,
+                    crash_dir=str(tmp_path / "crash")).validate()
+    obs = Obs.from_config(cfg)
+    url = obs.server.url
+    assert _get_json(url + "/status")["schema"] == "moxt-status-v1"
+    with pytest.raises(RuntimeError, match="boom"):
+        with obs.recording(cfg, "wordcount"):
+            obs.registry.count("did_work", 3)
+            raise RuntimeError("boom")
+    # server refused, sampler thread dead — clean shutdown on the abort
+    with pytest.raises((urllib.error.URLError, OSError)):
+        _get(url + "/status", timeout=2)
+    obs.series._thread.join(timeout=10)
+    assert not obs.series._thread.is_alive()
+    bundles = list((tmp_path / "crash").iterdir())
+    assert len(bundles) == 1
+    doc = json.loads((bundles[0] / "metrics.json").read_text())
+    assert doc["series"]["schema"] == "moxt-series-v1"
+    assert doc["counters"]["did_work"] == 3
+    # satellite: the bundle dir feeds obs xprof directly (no extraction)
+    from map_oxidize_tpu.cli import main
+
+    assert main(["obs", "xprof", str(bundles[0])]) == 0
+    assert main(["obs", "xprof", str(tmp_path / "crash")]) == 0
+
+
+# --- comms observatory -----------------------------------------------------
+
+
+def test_comms_oracle_sharded_merge(tmp_path):
+    """The comms table's all_to_all bytes equal the exchange-payload
+    oracle for the shapes actually exchanged, and the flat gate counters
+    agree with the table."""
+    import jax
+
+    from map_oxidize_tpu.api import MapOutput, SumReducer
+    from map_oxidize_tpu.obs import Obs
+    from map_oxidize_tpu.parallel.engine import ShardedReduceEngine
+    from map_oxidize_tpu.parallel.shuffle import exchange_payload_bytes
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the 8-virtual-device CPU mesh")
+    cfg = JobConfig(input_path=str(tmp_path / "x"), batch_size=1 << 10,
+                    key_capacity=1 << 12).validate()
+    obs = Obs.from_config(cfg)
+    eng = ShardedReduceEngine(cfg, SumReducer())
+    eng.obs = obs
+    rng = np.random.default_rng(3)
+    n_feeds = 3
+    for _ in range(n_feeds):
+        keys = rng.integers(0, 1 << 32, 512, dtype=np.uint64)
+        out = MapOutput(hi=(keys >> 32).astype(np.uint32),
+                        lo=keys.astype(np.uint32),
+                        values=np.ones(512, np.int32), records_in=512)
+        eng.feed(out)
+    eng.flush()
+    table = obs.registry.comms_table()
+    a2a = [r for r in table if r["collective"] == "all_to_all"
+           and r["program"] == "shuffle/merge"]
+    assert len(a2a) == 1
+    row = a2a[0]
+    exchanges = obs.registry.counters["shuffle/exchanges"]
+    oracle = exchanges * exchange_payload_bytes(eng.S, eng.bucket_cap, 4)
+    assert row["count"] == exchanges
+    assert row["bytes"] == oracle
+    assert row["shape"] == f"{eng.S}x{eng.bucket_cap}"
+    # sampled latency: the first exchange is always sampled
+    assert row["latency_ms"] and row["latency_ms"]["count"] >= 1
+    # flat gate counters mirror the table
+    c = obs.registry.counters
+    assert c["comms/all_to_all/shuffle/merge/bytes"] == oracle
+    assert c["comms/all_to_all/shuffle/merge/calls"] == exchanges
+    assert c["shuffle/all_to_all_bytes"] == oracle  # legacy counter agrees
+    # the psum rider is tabled too
+    assert any(r["collective"] == "psum" and r["program"] == "shuffle/merge"
+               for r in table)
+    obs.finish_xprof()
+
+
+def test_comms_in_metrics_doc_and_ledger(tmp_path):
+    """End-to-end: a sharded inverted-index run exports the comms table
+    in the metrics doc AND the ledger entry, with flat comms counters in
+    the entry's metrics."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the 8-virtual-device CPU mesh")
+    from map_oxidize_tpu.runtime.driver import run_inverted_index_job
+
+    corpus = tmp_path / "docs.txt"
+    _write_corpus(corpus, lines=60)
+    cfg = JobConfig(input_path=str(corpus), output_path="", metrics=False,
+                    batch_size=1 << 10,
+                    metrics_out=str(tmp_path / "m.json"),
+                    ledger_dir=str(tmp_path / "ledger"))
+    run_inverted_index_job(cfg)
+    doc = json.loads((tmp_path / "m.json").read_text())
+    assert any(r["program"] == "collect/route_append"
+               for r in doc["comms"])
+    from map_oxidize_tpu.obs import ledger
+
+    (entry,) = ledger.read(str(tmp_path / "ledger"))
+    assert any(r["program"] == "collect/route_append"
+               for r in entry["comms"])
+    assert any(k.startswith("comms/all_to_all/collect/route_append")
+               for k in entry["metrics"])
+
+
+def test_comms_gate_catches_injected_regression():
+    """The ledger gate flags unexplained comms-bytes growth (and stall
+    episodes), and passes identical comms."""
+    from map_oxidize_tpu.obs import ledger
+
+    base = {"ts_unix_s": 1.0, "version": "x", "config_hash": "h",
+            "workload": "wordcount", "corpus_bytes": 100, "n_processes": 1,
+            "phases_s": {}, "metrics": {
+                "comms/all_to_all/shuffle/merge/bytes": 1 << 20,
+                "comms/all_to_all/shuffle/merge/calls": 4,
+            }}
+    same = dict(base, ts_unix_s=2.0)
+    diff = ledger.diff_entries(base, same, threshold_pct=10.0)
+    assert diff["regressions"] == []
+    worse = dict(base, ts_unix_s=3.0, metrics=dict(
+        base["metrics"], **{
+            "comms/all_to_all/shuffle/merge/bytes": 2 << 20}))
+    diff = ledger.diff_entries(base, worse, threshold_pct=10.0)
+    assert any("unexplained comms growth" in r for r in diff["regressions"])
+    # a collective appearing from nothing flags too
+    appeared = dict(base, ts_unix_s=4.0, metrics=dict(
+        base["metrics"], **{"comms/psum/new_site/bytes": 4096}))
+    diff = ledger.diff_entries(base, appeared, threshold_pct=10.0)
+    assert any("comms/psum/new_site/bytes" in r
+               for r in diff["regressions"])
+    # stall satellite: any stall increase is a regression
+    stalled = dict(base, ts_unix_s=5.0, metrics=dict(
+        base["metrics"], **{"heartbeat/stalls": 2}))
+    diff = ledger.diff_entries(base, stalled, threshold_pct=10.0)
+    assert any("stall episodes" in r for r in diff["regressions"])
+
+
+def test_obs_diff_crash_dir(tmp_path, capsys):
+    """Satellite: ``obs diff --crash-dir`` compares a flight bundle
+    against the ledger with no hand extraction."""
+    from map_oxidize_tpu.cli import main
+    from map_oxidize_tpu.obs import Obs, ledger
+
+    cfg = JobConfig(input_path=str(tmp_path / "x"),
+                    ledger_dir=str(tmp_path / "ledger"),
+                    crash_dir=str(tmp_path / "crash")).validate()
+    # a completed run appends the ledger entry
+    obs = Obs.from_config(cfg)
+    with obs.recording(cfg, "wordcount"):
+        obs.registry.count("comms/psum/p/bytes", 1024)
+    obs.finish(cfg, "wordcount")
+    # then the same job crashes with doubled comms bytes
+    obs2 = Obs.from_config(cfg)
+    try:
+        with obs2.recording(cfg, "wordcount"):
+            obs2.registry.count("comms/psum/p/bytes", 4096)
+            raise RuntimeError("injected")
+    except RuntimeError:
+        pass
+    assert len(ledger.read(str(tmp_path / "ledger"))) == 1
+    rc = main(["obs", "diff", "--ledger-dir", str(tmp_path / "ledger"),
+               "--crash-dir", str(tmp_path / "crash"), "--gate"])
+    out = capsys.readouterr().out
+    assert "crash bundle" in out
+    assert "comms/psum/p/bytes" in out
+    assert rc == 3  # the injected comms growth gates
+
+
+# --- ObsContext isolation --------------------------------------------------
+
+
+def test_two_obs_context_isolation(tmp_path):
+    """Two concurrent jobs in one process keep disjoint metrics state:
+    dispatches made under each context land in that job's registry
+    only (the resident-server groundwork)."""
+    import jax
+    import jax.numpy as jnp
+
+    from map_oxidize_tpu.obs import Obs
+    from map_oxidize_tpu.obs.compile import observed_jit
+    from map_oxidize_tpu.obs.context import current_obs, use_obs
+
+    cfg = JobConfig(input_path=str(tmp_path / "x")).validate()
+    obs_a = Obs.from_config(cfg)
+    obs_b = Obs.from_config(cfg)
+    prog = observed_jit("ctx/test_prog", jax.jit(lambda x: x + 1))
+    barrier = threading.Barrier(2)
+
+    def _job(obs, n, arr):
+        with use_obs(obs):
+            assert current_obs() is obs
+            barrier.wait(timeout=30)
+            for _ in range(n):
+                np.asarray(prog(arr))
+
+    x = jnp.arange(8)
+    ta = threading.Thread(target=_job, args=(obs_a, 5, x))
+    tb = threading.Thread(target=_job, args=(obs_b, 9, x))
+    ta.start()
+    tb.start()
+    ta.join(timeout=120)
+    tb.join(timeout=120)
+    ha = obs_a.registry.histograms.get("device/dispatch_gap_ms")
+    hb = obs_b.registry.histograms.get("device/dispatch_gap_ms")
+    # the compiling call is excluded from the gap histogram; whichever
+    # thread compiled lost one observation
+    assert ha is not None and hb is not None
+    assert ha.count + hb.count == 5 + 9 - 1
+    assert {ha.count, hb.count} in ({4, 9}, {5, 8})
+    # per-job xprof deltas see each job's own dispatches
+    da = obs_a.finish_xprof()
+    db = obs_b.finish_xprof()
+    assert (da["programs"]["ctx/test_prog"]["dispatches"]
+            + db["programs"]["ctx/test_prog"]["dispatches"]) == 14
+    # registries never shared a counter
+    assert obs_a.registry is not obs_b.registry
+
+
+# --- 2-process Gloo: per-proc ports + proc-0 aggregate ---------------------
+
+_CHILD = r"""
+import json, logging, sys
+pid = int(sys.argv[1]); nproc = int(sys.argv[2]); port = sys.argv[3]
+corpus = sys.argv[4]; art = sys.argv[5]
+from map_oxidize_tpu.config import JobConfig
+from map_oxidize_tpu.utils.logging import configure
+from map_oxidize_tpu.parallel.distributed import (
+    init_distributed, run_distributed_job)
+configure(logging.INFO)
+init_distributed(f"127.0.0.1:{port}", num_processes=nproc, process_id=pid)
+cfg = JobConfig(input_path=corpus, output_path="", chunk_bytes=2048,
+                batch_size=1 << 12, key_capacity=1 << 12, top_k=5,
+                metrics=False, obs_port=0, obs_sample_s=0.05,
+                dist_coordinator=f"127.0.0.1:{port}",
+                dist_num_processes=nproc, dist_process_id=pid,
+                metrics_out=f"{art}/m.json")
+r = run_distributed_job(cfg, "wordcount")
+print("RESULT", json.dumps({"records": r.records}))
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _dist_env(portfile: str):
+    env = dict(os.environ)
+    for k in ("PALLAS_AXON_POOL_IPS", "PJRT_LIBRARY_PATH",
+              "TPU_LIBRARY_PATH", "PJRT_DEVICE", "TPU_ACCELERATOR_TYPE",
+              "TPU_TOPOLOGY", "TPU_WORKER_HOSTNAMES"):
+        env.pop(k, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["MOXT_OBS_PORT_FILE"] = portfile
+    return env
+
+
+def test_distributed_per_proc_ports_and_aggregate(tmp_path):
+    """2 Gloo processes with --obs-port 0: each serves its OWN port,
+    both /status docs carry their process slot, proc 0's carries the
+    skew-aware aggregate — scraped live, mid-run."""
+    corpus = tmp_path / "c.txt"
+    _write_corpus(corpus, lines=4000)
+    portfile = tmp_path / "ports.txt"
+    env = _dist_env(str(portfile))
+    port = _free_port()
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _CHILD, str(i), "2", str(port),
+         str(corpus), str(tmp_path)],
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True) for i in range(2)]
+    scraped: dict = {}
+    err = None
+    try:
+        deadline = time.monotonic() + 300
+        ports: dict = {}
+        while time.monotonic() < deadline and len(ports) < 2:
+            if portfile.exists():
+                for line in portfile.read_text().splitlines():
+                    p, prt = line.split()
+                    ports[int(p)] = int(prt)
+            if any(p.poll() is not None for p in procs):
+                break
+            time.sleep(0.02)
+        assert len(ports) == 2, f"port discovery failed: {ports}"
+        assert ports[0] != ports[1]
+        # scrape BOTH processes mid-run (retry: the doc must show an
+        # open phase to count as mid-run evidence)
+        while time.monotonic() < deadline and len(scraped) < 2:
+            for slot, prt in ports.items():
+                if slot in scraped:
+                    continue
+                try:
+                    doc = _get_json(f"http://127.0.0.1:{prt}/status")
+                except (urllib.error.URLError, OSError):
+                    continue
+                if doc.get("phase"):
+                    scraped[slot] = doc
+            time.sleep(0.02)
+    except BaseException as e:
+        err = e
+    logs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            out = "(timeout)"
+        logs.append(out)
+    if err is not None:
+        raise AssertionError(f"scrape failed: {err}\n--- proc0:\n"
+                             f"{logs[0]}\n--- proc1:\n{logs[1]}")
+    for i, p in enumerate(procs):
+        assert p.returncode == 0, f"process {i} failed:\n{logs[i]}"
+    assert len(scraped) == 2, f"mid-run scrape incomplete:\n{logs[0]}"
+    for slot, doc in scraped.items():
+        assert doc["schema"] == "moxt-status-v1"
+        assert doc["process"] == slot
+        assert doc["n_processes"] == 2
+        assert doc["meta"]["workload"] == "wordcount"
+    agg = scraped[0].get("aggregate")
+    assert agg is not None, "proc 0 /status lacks the aggregate"
+    assert agg["n_processes"] == 2
+    assert "collective_wait_frac" in agg
+    assert "est_rows_per_sec" in agg
+    assert "aggregate" not in scraped[1]
+    # per-process metrics docs carry the distributed comms observatory
+    md0 = json.loads((tmp_path / "m.json.proc0").read_text())
+    comms_progs = {r["program"] for r in md0["comms"]}
+    assert "dist/flag_psum" in comms_progs
+    assert "shuffle/merge" in comms_progs
+    assert "dist/gather_strings" in comms_progs
+    assert md0["series"]["schema"] == "moxt-series-v1"

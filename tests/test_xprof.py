@@ -287,7 +287,7 @@ def test_stall_detector_fires_once_and_rearms():
         assert len(lines) == 1
         assert "[stalled]" in lines[0]
         assert "phase/map+reduce" in lines[0]
-        assert obs.registry.counters.get("stall_warnings") == 1
+        assert obs.registry.counters.get("heartbeat/stalls") == 1
         # a completing chunk re-arms the detector
         obs.registry.observe("feed_block_ms", 1.0)
         assert sampler.check_stall(now=t + 8.0) is False
