@@ -1179,7 +1179,105 @@ def _bench_workloads(run_job, JobConfig, probes=None) -> dict:
                 f"bf16 drift {drift:.4f} exceeds rounding bound "
                 f"{4 * 2.0**-8 * scale:.4f} vs f32-HIGHEST")
         out[f"kmeans_device_bf16_2m_d64_k256_{iters2}iter"] = entry_b
+
+    # --- resident job service (ISSUE-7): N back-to-back small wordcounts
+    # through the server — the warm-compile story, measured and gated
+    _release_heap()
+    try:
+        entry = _bench_serve(slice_path)
+    except Exception as e:  # the serve bench must not discard other rows
+        out["serve_warm_small_jobs_error"] = f"{type(e).__name__}: {e}"
+    else:
+        if "error" in entry:
+            out["serve_warm_small_jobs_error"] = entry["error"]
+        else:
+            out["serve_warm_small_jobs"] = entry
     return out
+
+
+def _bench_serve(corpus: str, n_jobs: int = 6) -> dict:
+    """``serve_warm_small_jobs``: submit ``n_jobs`` identical small
+    wordcounts to an in-process resident server back to back.
+
+    Job 1 is the COLD job (it pays whatever XLA compiles this process
+    still owes); every later job must show a ZERO per-job ``compile/*``
+    delta — the per-job compile-ledger accounting enforces it here AND
+    in the ledger gate (the compile counters ride the entry's
+    metrics_snapshot, where any later increase fails ``--gate``).  The
+    entry also records where warm p50 job time goes: ``warm_setup_frac``
+    is the share of wall OUTSIDE the driver's measured phases (process/
+    scheduler/dispatch plumbing) — the acceptance bar is that warm
+    latency is dominated by the compute phases, not setup."""
+    import shutil
+
+    from map_oxidize_tpu.config import ServeConfig
+    from map_oxidize_tpu.serve.server import ResidentServer
+
+    spool = os.path.join(CACHE_DIR, "serve_spool")
+    shutil.rmtree(spool, ignore_errors=True)
+    srv = ResidentServer(ServeConfig(
+        port=0, workers=1, spool_dir=spool,
+        ledger_dir="none",      # bench owns the ledger entries it gates
+    ).validate()).start()
+    times: list[float] = []
+    compiles: list[int] = []
+    summaries: list[dict] = []
+    try:
+        for i in range(n_jobs):
+            t0 = time.perf_counter()
+            job = srv.submit("wordcount", corpus)
+            done = srv.wait(job.id, timeout=600)
+            dt = time.perf_counter() - t0
+            if done.state != "done":
+                return {"error": f"serve job {i} {done.state}: "
+                                 f"{done.reason}"}
+            times.append(dt)
+            compiles.append(int(done.summary.get(
+                "compile/total_compiles", 0)))
+            summaries.append(done.summary)
+    finally:
+        srv.shutdown()
+    # median WARM job by wall clock; its own summary provides the phase
+    # split, so warm_setup_frac compares one job's phases to that same
+    # job's wall (mixing jobs could hide real setup overhead behind a
+    # slow last job)
+    warm_idx = sorted(range(1, len(times)), key=times.__getitem__)
+    mi = warm_idx[len(warm_idx) // 2]
+    warm_p50 = times[mi]
+    if any(compiles[1:]):
+        return {"error": f"warm serve jobs recompiled: per-job compile "
+                         f"deltas {compiles} (job 1 may compile, later "
+                         "jobs must not)"}
+    median = summaries[mi]
+    words = int(median.get("records_in", 0))
+    phases = {k: round(v, 4) for k, v in median.items()
+              if k.startswith("time/") and k.endswith("_s")}
+    phase_total = sum(phases.values())
+    entry = {
+        "jobs": n_jobs,
+        "cold_s": round(times[0], 3),
+        "warm_p50_s": round(warm_p50, 3),
+        "warm_runs_s": [round(t, 3) for t in times[1:]],
+        "cold_over_warm": round(times[0] / warm_p50, 3),
+        "words_per_sec": round(words / warm_p50, 1),
+        "per_job_compile_deltas": compiles,
+        "warm_zero_compile_delta": True,
+        "warm_phases_s": phases,
+        # share of warm wall outside the driver's phases: submit/queue/
+        # scheduler plumbing — the "setup" the resident server exists to
+        # amortize away (phases == device-feeding compute work)
+        "warm_setup_frac": round(
+            max(1.0 - phase_total / warm_p50, 0.0), 4),
+        "scoreboard": False,     # a latency record, not a vs-CPU ratio
+        "note": "N identical small wordcounts through the resident "
+                "server; compile/* deltas are zero from job 2 on "
+                "(gate-enforced via metrics_snapshot)",
+        "metrics_snapshot": {k: v for k, v in median.items()
+                             if k.startswith(("compile/", "xprof/",
+                                              "time/", "pipeline/",
+                                              "heartbeat/"))},
+    }
+    return entry
 
 
 if __name__ == "__main__":

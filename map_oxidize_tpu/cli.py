@@ -8,6 +8,9 @@ directory containing ``shakes.txt``.  Usage here:
     python -m map_oxidize_tpu bigram corpus.txt --backend tpu
     python -m map_oxidize_tpu obs merge trace.json     # shard merge
     python -m map_oxidize_tpu obs diff --ledger-dir runs/  # regression diff
+    python -m map_oxidize_tpu serve --port 8321        # resident job server
+    python -m map_oxidize_tpu submit --url http://127.0.0.1:8321 \\
+        wordcount corpus.txt --wait                    # enqueue a job
 """
 
 from __future__ import annotations
@@ -228,6 +231,17 @@ def main(argv: list[str] | None = None) -> int:
         from map_oxidize_tpu.obs.cli import obs_main
 
         return obs_main(argv[1:])
+    if argv and argv[0] == "serve":
+        # resident job server (serve/): long-lived process, jobs arrive
+        # over HTTP — none of the one-shot workload flags below apply
+        from map_oxidize_tpu.serve.cli import serve_main
+
+        return serve_main(argv[1:])
+    if argv and argv[0] == "submit":
+        # client side: pure HTTP, no jax, no backend init
+        from map_oxidize_tpu.serve.cli import submit_main
+
+        return submit_main(argv[1:])
     args = build_parser().parse_args(argv)
     configure(logging.DEBUG if args.verbose
               else logging.WARNING if args.quiet else logging.INFO)

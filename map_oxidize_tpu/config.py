@@ -12,6 +12,13 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from pathlib import Path
 
+#: workloads the resident job service serves — the single source of
+#: truth for the scheduler's submit-time allowlist AND the submit CLI's
+#: ``choices`` (both import it from here, the one module each already
+#: depends on without pulling in jax)
+SERVE_WORKLOADS = ("wordcount", "bigram", "invertedindex", "kmeans",
+                   "distinct")
+
 
 @dataclass
 class JobConfig:
@@ -230,4 +237,78 @@ class JobConfig:
             raise ValueError(
                 "distributed mode needs dist_num_processes >= 2 and "
                 "0 <= dist_process_id < dist_num_processes")
+        return self
+
+
+@dataclass
+class ServeConfig:
+    """Resident job service configuration (``python -m map_oxidize_tpu
+    serve``): the long-lived server that holds the mesh, warm jit caches,
+    and opened corpora across jobs, and multiplexes submitted jobs over
+    the existing drivers.  Per-JOB knobs stay on :class:`JobConfig` —
+    clients send overrides with each submission; this object configures
+    the server process itself."""
+
+    #: HTTP bind: the obs telemetry plane (/metrics /status /series)
+    #: plus the job endpoints (/jobs, submit, cancel, shutdown).
+    #: 0 = ephemeral (logged, and written to ``MOXT_OBS_PORT_FILE``)
+    host: str = "127.0.0.1"
+    port: int = 0
+    #: concurrent job slots: worker threads multiplexing admitted jobs
+    #: over the pipeline (each runs a full driver under its own Obs)
+    workers: int = 2
+    #: bounded submission queue: submissions past it are REJECTED with a
+    #: named reason (``queue_full``), never silently dropped
+    max_queue: int = 16
+    #: HBM admission budget in bytes: jobs whose estimated device working
+    #: set cannot ever fit are rejected, jobs that do not fit NEXT TO the
+    #: currently running set are deferred until HBM frees.  0 = probe the
+    #: visible devices' reported memory (sum of bytes_limit); devices
+    #: without memory stats (CPU) leave admission open
+    hbm_budget_bytes: int = 0
+    #: server working directory: per-job artifact spool
+    #: (``<spool>/<job_id>/`` holds the metrics doc, output, and crash
+    #: bundles) plus the default ledger location
+    spool_dir: str = "moxt-serve-spool"
+    #: run ledger shared by every job the server finishes (per-job
+    #: entries — the same ledger ``obs diff`` reads); empty = ``<spool>/
+    #: ledger``; "none" disables
+    ledger_dir: str = ""
+    #: cached-corpus idle eviction: an opened corpus unused by any job
+    #: for this long is closed (page-cache warmth and the fd are
+    #: released); 0 disables eviction
+    idle_evict_s: float = 300.0
+    #: graceful-drain budget: on shutdown, running + already-admitted
+    #: jobs get this long to finish before remaining ones are cancelled
+    drain_timeout_s: float = 60.0
+    #: server-level telemetry cadence (the time-series ring + HBM
+    #: sampler on the server's own obs bundle)
+    obs_sample_s: float = 1.0
+    #: per-job silent-heartbeat/series cadence (gives every job's /jobs
+    #: row live rows/sec without --progress); 0 disables
+    job_sample_s: float = 0.5
+    #: terminal-job retention: /jobs lists at most this many finished/
+    #: rejected jobs; older ones are dropped from memory (their spool
+    #: artifacts remain on disk) so a resident process stays bounded
+    max_history: int = 512
+
+    def validate(self) -> "ServeConfig":
+        if not 0 <= self.port <= 65535:
+            raise ValueError("serve port must be 0 (ephemeral) or a port")
+        if self.workers < 1:
+            raise ValueError("serve workers must be >= 1")
+        if self.max_queue < 1:
+            raise ValueError("serve max_queue must be >= 1")
+        if self.hbm_budget_bytes < 0:
+            raise ValueError("hbm_budget_bytes must be >= 0 (0 = probe)")
+        if self.idle_evict_s < 0 or self.drain_timeout_s < 0:
+            raise ValueError("idle_evict_s and drain_timeout_s must be "
+                             ">= 0")
+        if self.obs_sample_s < 0 or self.job_sample_s < 0:
+            raise ValueError("obs_sample_s and job_sample_s must be >= 0")
+        if self.max_history < 1:
+            raise ValueError("max_history must be >= 1 (a finished job "
+                             "must stay visible to its waiting client)")
+        if not self.spool_dir:
+            raise ValueError("spool_dir must be set")
         return self

@@ -61,7 +61,8 @@ from __future__ import annotations
 import contextlib
 import json
 import os
-from dataclasses import dataclass
+import threading
+from dataclasses import dataclass, field
 
 from map_oxidize_tpu.obs.heartbeat import Heartbeat
 from map_oxidize_tpu.obs.metrics import (
@@ -75,6 +76,7 @@ from map_oxidize_tpu.obs.trace import NULL_SPAN, Span, Tracer
 __all__ = [
     "Heartbeat",
     "Histogram",
+    "JobCancelled",
     "MetricsRegistry",
     "NULL_SPAN",
     "Obs",
@@ -83,6 +85,17 @@ __all__ = [
     "sample_device_memory",
     "sample_host_memory",
 ]
+
+
+class JobCancelled(RuntimeError):
+    """Cooperative cancellation: a client cancel or an expired deadline.
+
+    Raised by :meth:`Obs.poll_cancel` from inside the job body — i.e.
+    inside ``Obs.recording`` — so the abort takes the flight-recorder
+    path like any other: open spans close, partial metrics/trace flush,
+    and the crash bundle lands before the exception reaches the caller
+    (the resident server's scheduler, which maps it to the job's
+    ``cancelled`` state instead of ``failed``)."""
 
 
 @dataclass
@@ -118,6 +131,13 @@ class Obs:
     #: recording — what /status reports while the job runs
     current_phase: "str | None" = None
     workload: "str | None" = None
+    #: cooperative cancellation (the resident job service's cancel and
+    #: deadline paths): set via :meth:`request_cancel` from ANY thread,
+    #: observed at phase boundaries and per-block feeds by
+    #: :meth:`poll_cancel`, which raises :class:`JobCancelled` inside the
+    #: job body so the flight recorder runs
+    cancel_event: threading.Event = field(default_factory=threading.Event)
+    cancel_reason: "str | None" = None
 
     @classmethod
     def from_config(cls, config, process: int = 0,
@@ -205,12 +225,31 @@ class Obs:
             obs.server.start()
         return obs
 
+    def request_cancel(self, reason: str = "cancelled") -> None:
+        """Ask the job to stop at its next cancellation point (phase
+        boundary or per-block feed).  Thread-safe; the first reason
+        wins.  A job that never reaches another cancellation point (a
+        wedged collective) is the stall detector's department — this is
+        the cooperative path."""
+        if not self.cancel_event.is_set():
+            self.cancel_reason = reason
+            self.cancel_event.set()
+
+    def poll_cancel(self) -> None:
+        """Raise :class:`JobCancelled` if a cancel was requested.  Called
+        at every phase start and per-block feed; one ``Event.is_set``
+        check on the not-cancelled path."""
+        if self.cancel_event.is_set():
+            raise JobCancelled(self.cancel_reason or "cancelled")
+
     @contextlib.contextmanager
     def phase(self, name: str, **attrs):
         """One job phase: wall-clocked in the registry, a top-level span in
         the trace, the heartbeat's current phase label, and a host-RSS
         watermark sample on exit (phase boundaries are where residency
-        peaks: finalize fetches, sort buffers, write staging)."""
+        peaks: finalize fetches, sort buffers, write staging).  Also a
+        cancellation point (:meth:`poll_cancel`)."""
+        self.poll_cancel()
         if self.heartbeat is not None:
             self.heartbeat.set_phase(name)
         prev, self.current_phase = self.current_phase, name
@@ -224,7 +263,10 @@ class Obs:
 
     def feed_span(self, **attrs) -> "Span":
         """Span for one mapped block's engine feed (the per-block latency
-        site every driver instruments)."""
+        site every driver instruments) — and the job's fine-grained
+        cancellation point: a cancel/deadline lands between blocks, never
+        mid-feed."""
+        self.poll_cancel()
         return self.tracer.span("engine/feed_block", **attrs)
 
     def stamp(self, config, workload: str | None = None) -> dict:

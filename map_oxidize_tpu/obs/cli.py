@@ -22,7 +22,10 @@ Four subcommands, all pure host-side work (no jax, no backend init):
 * ``obs top`` — live terminal view of a running job: polls the
   ``--obs-port`` server's ``/status`` and redraws phase, rows/sec, ETA,
   the compile/MFU table, HBM, and the comms table.  Curses-free (plain
-  ANSI redraw), so it works in any terminal and over ssh.
+  ANSI redraw), so it works in any terminal and over ssh.  Pointed at a
+  RESIDENT job server (``python -m map_oxidize_tpu serve``) it also
+  renders the ``/jobs`` table — queued/running/done jobs with per-job
+  phase, rows/sec, and compile deltas — next to the single-job view.
 """
 
 from __future__ import annotations
@@ -336,13 +339,47 @@ def render_status(doc: dict) -> str:
     return "\n".join(lines)
 
 
+def render_jobs(doc: dict) -> str:
+    """The resident server's ``/jobs`` table as an ``obs top`` section.
+    Pure, so tests pin the rendering without a server."""
+    counts = doc.get("counts") or {}
+    q = doc.get("queue") or {}
+    summary = ", ".join(f"{n} {s}" for s, n in sorted(counts.items()))
+    head = (f"jobs ({summary or 'none yet'};"
+            f" queue {q.get('depth', 0)}/{q.get('max', '?')}")
+    hbm = doc.get("hbm") or {}
+    if hbm.get("budget_bytes"):
+        in_use = max(hbm.get("reserved_bytes", 0),
+                     hbm.get("measured_live_bytes", 0))
+        head += (f", hbm {_fmt_bytes(in_use)}"
+                 f"/{_fmt_bytes(hbm['budget_bytes'])}")
+    if doc.get("draining"):
+        head += ", DRAINING"
+    lines = [head + "):"]
+    lines.append(f"  {'id':<10} {'state':<9} {'workload':<13} {'phase':<12} "
+                 f"{'rows/s':>9} {'compiles':>8}  reason")
+    for r in (doc.get("jobs") or [])[:12]:
+        rate = r.get("rows_per_sec")
+        if rate is None and r.get("records_in") and r.get("duration_s"):
+            rate = round(r["records_in"] / max(r["duration_s"], 1e-9), 1)
+        compiles = r.get("compiles")
+        lines.append(
+            f"  {r['id']:<10} {r['state']:<9} {r['workload']:<13} "
+            f"{(r.get('phase') or '-'):<12} "
+            f"{(f'{rate:,.0f}' if rate is not None else '-'):>9} "
+            f"{(compiles if compiles is not None else '-'):>8}  "
+            f"{r.get('reason') or '-'}")
+    return "\n".join(lines)
+
+
 def _top(args) -> int:
     import json
     import time
     import urllib.error
     import urllib.request
 
-    url = args.url.rstrip("/") + "/status"
+    base = args.url.rstrip("/")
+    url = base + "/status"
     polls = 0
     seen_one = False
     try:
@@ -360,6 +397,16 @@ def _top(args) -> int:
                 return 2
             seen_one = True
             frame = render_status(doc)
+            # a resident job server carries /jobs too: render the table
+            # (plain per-job telemetry servers 404 here — skip silently)
+            try:
+                with urllib.request.urlopen(base + "/jobs",
+                                            timeout=5) as resp:
+                    jobs_doc = json.loads(resp.read())
+                if jobs_doc.get("schema") == "moxt-jobs-v1":
+                    frame += "\n" + render_jobs(jobs_doc)
+            except (urllib.error.URLError, OSError, ValueError):
+                pass
             if args.no_clear:
                 print(frame)
                 print("-" * 40)

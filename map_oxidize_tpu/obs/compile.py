@@ -481,6 +481,23 @@ class ObservedJit:
         return out
 
 
+def job_overlay_delta(obs) -> dict:
+    """Live per-program compile/dispatch delta for a STILL-RECORDING job
+    (the overlay accounting — activity actually routed to this job).
+
+    The ``/jobs`` table and the resident server's warm-compile evidence
+    read this mid-run without closing the job's observatory window;
+    ``Obs.finish_xprof`` keeps owning the end-of-job export.  Returns
+    ``{}`` for a job whose window never opened (or already closed)."""
+    base = getattr(obs, "xprof_base", None)
+    if base is None:
+        return {}
+    local = LEDGER.overlay(obs)
+    if local is None:
+        return {}
+    return LEDGER.job_delta(base, local)
+
+
 def observed_jit(name: str, fn, tag=None) -> ObservedJit:
     """Observe an already-jitted callable under a stable program name.
     The name is the join key for everything downstream — compile counts,

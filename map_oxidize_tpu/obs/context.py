@@ -16,10 +16,17 @@ back to the ledger's last-activated job — the single-job behavior is
 unchanged, and two concurrent jobs in one process get disjoint
 metrics/ledger state (pinned by tests/test_obs_live.py).
 
-Note threads do NOT inherit a parent thread's binding: worker threads
-that record (the device sampler, the time-series recorder) hold their
-``Obs`` by reference instead, and the dispatch sites all run on the
-job's driver thread, inside ``recording``.
+Note threads do NOT inherit a parent thread's binding: a
+``contextvars.ContextVar`` is per-thread state, so a pool or prefetch
+worker spawned by a job thread starts UNBOUND and its observations would
+fall back to the ledger's last-activated job — under a resident server
+multiplexing jobs, the *wrong* job.  :func:`bind_current` is the
+explicit bind-on-spawn fix: capture the spawning context's binding once,
+and run the worker's target under it.  The pipeline producer thread
+(:mod:`map_oxidize_tpu.runtime.pipeline`) and the map pool's task
+closures (:mod:`map_oxidize_tpu.runtime.executor`) both spawn bound;
+long-lived service threads that record (the device sampler, the
+time-series recorder) keep holding their ``Obs`` by reference instead.
 """
 
 from __future__ import annotations
@@ -34,6 +41,27 @@ _CURRENT: contextvars.ContextVar = contextvars.ContextVar(
 def current_obs():
     """The ``Obs`` bound to this context, or None outside any job body."""
     return _CURRENT.get()
+
+
+def bind_current(fn):
+    """Capture the CALLING context's job binding now and return a wrapper
+    that runs ``fn`` under it — the bind-on-spawn helper for worker
+    threads (prefetch producers, map pool tasks) whose observations must
+    land in the spawning job's bundle, not whatever job happened to
+    activate last.  Outside any job binding this is the identity (no
+    wrapper object, no per-call overhead)."""
+    obs = _CURRENT.get()
+    if obs is None:
+        return fn
+
+    def _bound(*args, **kwargs):
+        token = _CURRENT.set(obs)
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            _CURRENT.reset(token)
+
+    return _bound
 
 
 @contextlib.contextmanager

@@ -19,6 +19,25 @@ shut down cleanly by ``Obs.finish`` *and* the flight recorder:
 * ``GET /series``  — the time-series ring
   (:mod:`map_oxidize_tpu.obs.timeseries`) as aligned value lists.
 
+When a resident job service (:mod:`map_oxidize_tpu.serve`) attaches its
+scheduler, the SAME server additionally exposes the job plane — one
+port, one process, no second server:
+
+* ``GET /jobs``            — the job table (queued/running/done, queue
+  depth, HBM admission snapshot, cached corpora);
+* ``GET /jobs/<id>``       — one job's full record (live phase/rows/sec
+  and per-job compile deltas while running; the flat metrics summary
+  once finished);
+* ``POST /jobs``           — submit (JSON body: ``workload``, ``input``,
+  optional ``config`` overrides / ``output`` / ``deadline_s`` /
+  ``est_hbm_bytes``); malformed requests 400, world-state refusals
+  (queue full, oversized, draining) return a ``rejected`` job record;
+* ``POST /jobs/<id>/cancel`` — queue-cancel or cooperative running-job
+  cancellation;
+* ``POST /shutdown``       — graceful drain request (body
+  ``{"drain": false}`` for immediate cancellation); the server's main
+  loop performs the teardown.
+
 All three are snapshot reads built under the registry's lock, so
 concurrent scrapes during a hot feed loop are safe (pinned by
 tests/test_obs_live.py); nothing here dispatches device work, so the
@@ -209,8 +228,28 @@ class _Handler(BaseHTTPRequestHandler):
         path = self.path.split("?", 1)[0]
         try:
             if path in ("/", "/healthz"):
-                self._json({"endpoints": ["/metrics", "/status", "/series"],
-                            "schema": STATUS_SCHEMA})
+                eps = ["/metrics", "/status", "/series"]
+                if srv.scheduler is not None:
+                    eps += ["/jobs", "/jobs/<id>"]
+                self._json({"endpoints": eps, "schema": STATUS_SCHEMA})
+            elif path == "/jobs":
+                if srv.scheduler is None:
+                    self._json({"error": "no job scheduler attached "
+                                         "(not a resident job server)"},
+                               code=404)
+                else:
+                    self._json(srv.scheduler.jobs_doc())
+            elif path.startswith("/jobs/"):
+                if srv.scheduler is None:
+                    self._json({"error": "no job scheduler attached"},
+                               code=404)
+                else:
+                    doc = srv.scheduler.job_doc(path[len("/jobs/"):])
+                    if doc is None:
+                        self._json({"error": f"unknown job {path!r}"},
+                                   code=404)
+                    else:
+                        self._json(doc)
             elif path == "/metrics":
                 body = prometheus_text(
                     srv.obs.registry,
@@ -231,6 +270,61 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 self._json({"error": f"unknown path {path!r}"}, code=404)
         except Exception as e:  # a scrape bug must not kill the job
+            try:
+                self._json({"error": f"{type(e).__name__}: {e}"}, code=500)
+            except Exception:
+                pass
+
+    def do_POST(self):  # noqa: N802 - BaseHTTPRequestHandler contract
+        srv = self.server
+        path = self.path.split("?", 1)[0]
+        sched = srv.scheduler
+        try:
+            if sched is None:
+                self._json({"error": "no job scheduler attached "
+                                     "(not a resident job server)"},
+                           code=404)
+                return
+            try:
+                n = int(self.headers.get("Content-Length") or 0)
+                body = json.loads(self.rfile.read(n) or b"{}")
+                if not isinstance(body, dict):
+                    raise ValueError("request body must be a JSON object")
+            except (ValueError, OSError) as e:
+                self._json({"error": f"bad request body: {e}"}, code=400)
+                return
+            if path == "/jobs":
+                try:
+                    job = sched.submit(
+                        workload=body.get("workload", ""),
+                        input_path=body.get("input", ""),
+                        overrides=body.get("config"),
+                        output_path=body.get("output", ""),
+                        deadline_s=body.get("deadline_s"),
+                        est_hbm_bytes=int(body.get("est_hbm_bytes") or 0),
+                    )
+                except (ValueError, TypeError) as e:
+                    self._json({"error": str(e)}, code=400)
+                else:
+                    # render the HELD record: a concurrent history prune
+                    # must not turn this response into JSON null
+                    self._json(sched.job_row(job))
+            elif path.startswith("/jobs/") and path.endswith("/cancel"):
+                job_id = path[len("/jobs/"):-len("/cancel")]
+                job = sched.cancel(
+                    job_id,
+                    reason=body.get("reason", "cancelled_by_client"))
+                if job is None:
+                    self._json({"error": f"unknown job {job_id!r}"},
+                               code=404)
+                else:
+                    self._json(sched.job_row(job))
+            elif path == "/shutdown":
+                sched.request_shutdown(drain=bool(body.get("drain", True)))
+                self._json({"ok": True, "draining": True})
+            else:
+                self._json({"error": f"unknown path {path!r}"}, code=404)
+        except Exception as e:  # a request bug must not kill the server
             try:
                 self._json({"error": f"{type(e).__name__}: {e}"}, code=500)
             except Exception:
@@ -259,6 +353,9 @@ class _Server(ThreadingHTTPServer):
     # set by ObsServer after construction
     obs = None
     config = None
+    #: resident job service hookup (None for plain per-job telemetry
+    #: servers — the /jobs plane then 404s)
+    scheduler = None
 
 
 class ObsServer:
@@ -267,10 +364,12 @@ class ObsServer:
     thread).  ``port=0`` binds an ephemeral port; the bound port is on
     ``.port`` and in the ``[obs] serving`` log line."""
 
-    def __init__(self, obs, config, port: int, host: str = "127.0.0.1"):
+    def __init__(self, obs, config, port: int, host: str = "127.0.0.1",
+                 scheduler=None):
         self._httpd = _Server((host, port), _Handler)
         self._httpd.obs = obs
         self._httpd.config = config
+        self._httpd.scheduler = scheduler
         self.host = host
         self.port = int(self._httpd.server_address[1])
         self.url = f"http://{host}:{self.port}"

@@ -35,31 +35,35 @@ def resolve_mapper(config: JobConfig, workload: str) -> str:
     return mode
 
 
-def run_job(config: JobConfig, workload: str = "wordcount"):
+def run_job(config: JobConfig, workload: str = "wordcount", on_obs=None):
     """Run a built-in workload end to end with the best available map path.
 
     With ``config.trace_dir`` set, the whole job runs under a
     ``jax.profiler`` trace (device timeline + host events) written there —
-    the deep-dive companion to the always-on phase wall-clocks."""
+    the deep-dive companion to the always-on phase wall-clocks.
+
+    ``on_obs`` receives the job's ``Obs`` bundle before the body starts
+    (the resident job service's live-status and cancel hookup; see
+    :func:`map_oxidize_tpu.runtime.driver.run_wordcount_job`)."""
     from map_oxidize_tpu.utils.profiling import jax_trace
 
     with jax_trace(config.trace_dir):
-        return _run_job(config, workload)
+        return _run_job(config, workload, on_obs)
 
 
-def _run_job(config: JobConfig, workload: str):
+def _run_job(config: JobConfig, workload: str, on_obs=None):
     if workload == "kmeans":
         from map_oxidize_tpu.runtime.driver import run_kmeans_job
 
-        return run_kmeans_job(config)
+        return run_kmeans_job(config, on_obs=on_obs)
     if workload == "invertedindex":
         from map_oxidize_tpu.runtime.driver import run_inverted_index_job
 
-        return run_inverted_index_job(config)
+        return run_inverted_index_job(config, on_obs=on_obs)
     if workload == "distinct":
         from map_oxidize_tpu.runtime.driver import run_distinct_job
 
-        return run_distinct_job(config)
+        return run_distinct_job(config, on_obs=on_obs)
     mode = resolve_mapper(config, workload)
     if mode == "device":
         from map_oxidize_tpu.runtime.device_map import (
@@ -70,8 +74,8 @@ def _run_job(config: JobConfig, workload: str):
 
         ngram = 2 if workload == "bigram" else 1
         if effective_num_shards(config) > 1:
-            return run_sharded_device_job(config, ngram)
-        return run_device_wordcount_job(config, ngram)
+            return run_sharded_device_job(config, ngram, on_obs=on_obs)
+        return run_device_wordcount_job(config, ngram, on_obs=on_obs)
 
     from map_oxidize_tpu.runtime.driver import run_wordcount_job
 
@@ -86,4 +90,5 @@ def _run_job(config: JobConfig, workload: str):
         mapper, reducer = make_bigram(config.tokenizer, use_native)
     else:
         raise ValueError(f"unknown workload {workload!r}")
-    return run_wordcount_job(config, mapper, reducer, workload=workload)
+    return run_wordcount_job(config, mapper, reducer, workload=workload,
+                             on_obs=on_obs)

@@ -119,7 +119,8 @@ class _DictBuilder:
             d.add(h, ngram_at(chunk, rl[i], ng))
 
 
-def run_sharded_device_job(config: JobConfig, ngram: int = 1) -> JobResult:
+def run_sharded_device_job(config: JobConfig, ngram: int = 1,
+                           on_obs=None) -> JobResult:
     """Word/n-gram count with the map phase on device across a mesh.
 
     Chunks are dealt round-robin onto shards in groups of S; one
@@ -133,6 +134,8 @@ def run_sharded_device_job(config: JobConfig, ngram: int = 1) -> JobResult:
     """
     config.validate()
     obs = Obs.from_config(config)
+    if on_obs is not None:
+        on_obs(obs)
     with obs.recording(config, "bigram" if ngram == 2 else "wordcount"):
         return _run_sharded_device_body(config, obs, ngram)
 
@@ -347,10 +350,13 @@ def _resume_snapshot(ckpt, engine, set_dictionary) -> tuple[int, int]:
     return resume_off, n_chunks
 
 
-def run_device_wordcount_job(config: JobConfig, ngram: int = 1) -> JobResult:
+def run_device_wordcount_job(config: JobConfig, ngram: int = 1,
+                             on_obs=None) -> JobResult:
     """Word/n-gram count with the map phase on device (single chip)."""
     config.validate()
     obs = Obs.from_config(config)
+    if on_obs is not None:
+        on_obs(obs)
     with obs.recording(config, "bigram" if ngram == 2 else "wordcount"):
         return _run_device_wordcount_body(config, obs, ngram)
 

@@ -249,7 +249,7 @@ def _track_offsets(chunk_iter, start_off: int, offsets: dict, base_idx: int):
 
 
 def run_wordcount_job(config: JobConfig, mapper: Mapper, reducer: Reducer,
-                      workload: str = "wordcount") -> JobResult:
+                      workload: str = "wordcount", on_obs=None) -> JobResult:
     """End-to-end word-count-shaped job (scalar sum values, string keys).
 
     With ``config.checkpoint_dir`` set, every mapped chunk is spilled
@@ -259,9 +259,16 @@ def run_wordcount_job(config: JobConfig, mapper: Mapper, reducer: Reducer,
     Any abort — the conservation/duplicate-key/overflow invariant checks
     included — passes through the flight recorder (``obs.recording``): open
     spans close, partial metrics/trace flush, and ``config.crash_dir`` gets
-    a post-mortem bundle before the exception propagates."""
+    a post-mortem bundle before the exception propagates.
+
+    ``on_obs`` (every driver takes it) hands the freshly built ``Obs``
+    bundle to an embedding runtime before the body starts — the resident
+    job service uses it to expose live phase/progress on ``/jobs`` and to
+    deliver cancel/deadline requests (``Obs.request_cancel``)."""
     config.validate()
     obs = Obs.from_config(config)
+    if on_obs is not None:
+        on_obs(obs)
     with obs.recording(config, workload):
         return _run_wordcount_body(config, obs, mapper, reducer, workload)
 
@@ -452,7 +459,8 @@ class InvertedIndexResult:
         return "\n".join(lines)
 
 
-def run_inverted_index_job(config: JobConfig) -> InvertedIndexResult:
+def run_inverted_index_job(config: JobConfig, on_obs=None
+                           ) -> InvertedIndexResult:
     """Inverted-index build (BASELINE config #4): map emits one (term, doc)
     pair per distinct term per document; the CollectEngine sorts all pairs
     once on device; postings fall out as contiguous segments.
@@ -462,6 +470,8 @@ def run_inverted_index_job(config: JobConfig) -> InvertedIndexResult:
     HashMap ordering could produce (main.rs:170-182)."""
     config.validate()
     obs = Obs.from_config(config)
+    if on_obs is not None:
+        on_obs(obs)
     with obs.recording(config, "invertedindex"):
         return _run_inverted_index_body(config, obs)
 
@@ -684,8 +694,8 @@ def _adopt_checkpoint_kmeans_mode(config: JobConfig,
     return stored if probe == want else None
 
 
-def run_kmeans_job(config: JobConfig, centroids: np.ndarray | None = None
-                   ) -> KMeansResult:
+def run_kmeans_job(config: JobConfig, centroids: np.ndarray | None = None,
+                   on_obs=None) -> KMeansResult:
     """k-means (BASELINE config #5), two execution paths:
 
     * HBM-resident (``mapper='device'``, and what ``'auto'`` resolves to
@@ -706,6 +716,8 @@ def run_kmeans_job(config: JobConfig, centroids: np.ndarray | None = None
     ``kmeans_k`` points (deterministic)."""
     config.validate()
     obs = Obs.from_config(config)
+    if on_obs is not None:
+        on_obs(obs)
     with obs.recording(config, "kmeans"):
         return _run_kmeans_body(config, obs, centroids)
 
@@ -982,7 +994,7 @@ class DistinctResult:
                 f"rse ~{104 / np.sqrt(self.registers.shape[0]):.2f}%)")
 
 
-def run_distinct_job(config: JobConfig) -> DistinctResult:
+def run_distinct_job(config: JobConfig, on_obs=None) -> DistinctResult:
     """Approximate distinct-token count (HyperLogLog): max-monoid fold over
     ``2^p`` integer-keyed registers — the most engine-friendly reduce shape
     there is (fixed tiny key space, no dictionary, no growth), shared
@@ -990,6 +1002,8 @@ def run_distinct_job(config: JobConfig) -> DistinctResult:
     See :mod:`map_oxidize_tpu.workloads.distinct` for the formulation."""
     config.validate()
     obs = Obs.from_config(config)
+    if on_obs is not None:
+        on_obs(obs)
     with obs.recording(config, "distinct"):
         return _run_distinct_body(config, obs)
 

@@ -78,8 +78,13 @@ def run_map_phase(
                 yield idx, _attempt(mapper, chunk, idx, max_retries)
         yield from pipelined(_inline(), pipeline_depth, obs, name="map")
         return
+    from map_oxidize_tpu.obs.context import bind_current
+
     chunks = pipelined(chunks, pipeline_depth, obs, name="read")
     max_inflight = max(2, 2 * num_workers)
+    # pool tasks observe under the SUBMITTING job's ObsContext (the pool
+    # threads themselves start unbound — see obs/context.bind_current)
+    attempt = bind_current(_attempt)
     with ThreadPoolExecutor(max_workers=num_workers, thread_name_prefix="map") as pool:
         inflight: dict[Future, int] = {}
         it = enumerate(chunks)
@@ -91,7 +96,7 @@ def run_map_phase(
                 except StopIteration:
                     exhausted = True
                     break
-                inflight[pool.submit(_attempt, mapper, chunk, idx, max_retries)] = idx
+                inflight[pool.submit(attempt, mapper, chunk, idx, max_retries)] = idx
             if not inflight:
                 return
             done, _ = wait(inflight, return_when=FIRST_COMPLETED)

@@ -69,13 +69,22 @@ class ChunkPrefetcher:
     def __init__(self, it: Iterable[T], depth: int, name: str = "pipeline"):
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        from map_oxidize_tpu.obs.context import bind_current
+
         self._it = iter(it)
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._name = name
         self._stop = False
         self._err: BaseException | None = None
+        # bind-on-spawn: the producer runs the job's host half (read +
+        # tokenize/map), and anything it observes — a device-mapper
+        # dispatch, a recompile warning — must route to the SPAWNING
+        # job's ObsContext; a bare thread starts unbound and would fall
+        # back to the ledger's last-activated job, which under a
+        # resident server multiplexing jobs is the wrong one
         self._thread = threading.Thread(
-            target=self._produce, daemon=True, name=f"{name}-prefetch")
+            target=bind_current(self._produce), daemon=True,
+            name=f"{name}-prefetch")
         self.depth = depth
         #: host time spent producing items (read+tokenize/map)
         self.produce_s = 0.0

@@ -1,0 +1,38 @@
+"""Resident job service: warm-compile multi-job serving with HBM
+admission control (ROADMAP open item 2 — the millions-of-users story).
+
+Every standalone run pays process startup, corpus open, and XLA compile;
+the PR-5 compile ledger proved compiles DOMINATE small-job latency, and
+DrJAX (arXiv:2403.07128) argues MapReduce-in-JAX lives or dies on a flat
+program count.  This package is the layer above the drivers that
+amortizes all three, Exoshuffle-style (arXiv:2203.05072): one long-lived
+process holds the mesh, the warm jit caches, and the opened corpora, and
+multiplexes many jobs over the existing pipeline.
+
+* :mod:`~map_oxidize_tpu.serve.scheduler` — bounded job queue, worker
+  threads running the existing drivers under per-job ``Obs`` bundles
+  (disjoint metrics/trace/ledger/compile accounting via ObsContext),
+  cooperative cancel/deadline through the flight recorder, graceful
+  drain;
+* :mod:`~map_oxidize_tpu.serve.admission` — HBM admission control:
+  admit / defer / reject against the device budget, with named reasons
+  instead of mid-run capacity aborts;
+* :mod:`~map_oxidize_tpu.serve.corpus` — opened-corpus cache with idle
+  eviction;
+* :mod:`~map_oxidize_tpu.serve.server` — the resident process: one HTTP
+  plane (the obs telemetry server + ``/jobs`` endpoints), signals,
+  lifecycle;
+* :mod:`~map_oxidize_tpu.serve.client` — the Python/HTTP client behind
+  ``python -m map_oxidize_tpu submit``.
+
+See ``docs/SERVING.md`` for endpoint schemas, the admission policy, and
+drain semantics.
+"""
+
+from __future__ import annotations
+
+from map_oxidize_tpu.serve.client import ServeClient
+from map_oxidize_tpu.serve.scheduler import Scheduler
+from map_oxidize_tpu.serve.server import ResidentServer
+
+__all__ = ["ResidentServer", "Scheduler", "ServeClient"]

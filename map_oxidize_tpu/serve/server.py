@@ -1,0 +1,143 @@
+"""The resident job server: warm-compile multi-job serving.
+
+``python -m map_oxidize_tpu serve`` keeps ONE process alive across jobs,
+so everything a cold job pays once per run is paid once per server:
+
+* the jax backend + mesh initialization (first job only);
+* XLA executables — the process-global jit caches stay warm, so N
+  back-to-back same-shape jobs compile exactly once (the compile ledger
+  proves it per job: ``compile/total_compiles == 0`` from job 2 on);
+* opened corpora (:mod:`map_oxidize_tpu.serve.corpus`).
+
+The server owns one obs bundle of its own (uptime /status, the HBM
+sampler feeding admission evidence, a time-series ring) and ONE HTTP
+plane — the existing :class:`~map_oxidize_tpu.obs.serve.ObsServer` with
+the scheduler attached, so ``/metrics /status /series`` and
+``/jobs /jobs/<id> + submit/cancel/shutdown`` share a port.
+
+Lifecycle: ``serve_forever`` blocks until a shutdown request (SIGTERM /
+SIGINT via :func:`install_signal_handlers`, or ``POST /shutdown``), then
+drains — running and admitted jobs finish (bounded by
+``drain_timeout_s``), new submissions reject with ``server_draining``,
+per-job ledgers/metrics docs flush as each job ends, and the HTTP plane
+stops last so a watcher sees the drain happen.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+
+from map_oxidize_tpu.config import JobConfig, ServeConfig
+from map_oxidize_tpu.obs import Obs
+from map_oxidize_tpu.obs.serve import ObsServer
+from map_oxidize_tpu.serve.scheduler import Scheduler
+from map_oxidize_tpu.utils.logging import get_logger
+
+_log = get_logger(__name__)
+
+
+class ResidentServer:
+    """One resident serving process: scheduler + obs bundle + HTTP plane.
+
+    Construct-and-start; ``submit``/``wait``/``cancel`` delegate to the
+    scheduler for in-process embedders (the bench harness, tests), HTTP
+    clients go through :class:`map_oxidize_tpu.serve.client.ServeClient`.
+    """
+
+    def __init__(self, cfg: ServeConfig, runner=None):
+        self.cfg = cfg.validate()
+        self.scheduler = Scheduler(cfg, runner=runner)
+        # the server's own obs bundle: a synthetic job config switches on
+        # the time-series ring + HBM sampler (admission evidence) but NOT
+        # a second HTTP server — this class owns the one plane below
+        self._obs_config = JobConfig(
+            input_path="", output_path="", metrics=False,
+            obs_port=-1, obs_sample_s=cfg.obs_sample_s,
+            hbm_sample_s=cfg.obs_sample_s,
+        )
+        self.obs = Obs.from_config(self._obs_config)
+        self.obs.workload = "serve"
+        self.http = ObsServer(self.obs, self._obs_config, cfg.port,
+                              host=cfg.host, scheduler=self.scheduler)
+        # finish/stop_live (and the flight recorder, were the server body
+        # ever aborted) shut the shared plane down exactly once
+        self.obs.server = self.http
+        self._stopped = threading.Event()
+
+    # --- lifecycle --------------------------------------------------------
+
+    def start(self) -> "ResidentServer":
+        self.http.start()
+        self.scheduler.start()
+        # warm the backend off the serving path: the resident server
+        # exists to pay jax/mesh init once, and the HBM admission budget
+        # can only probe real devices once jax is imported — without
+        # this, every submission before the FIRST job ran would be
+        # admitted unchecked on accelerator backends (the probe in
+        # admission.py deliberately never initializes a backend itself)
+        threading.Thread(target=self._warm_backend, daemon=True,
+                         name="serve-warmup").start()
+        _log.info("[serve] resident job server ready on %s "
+                  "(/jobs to submit)", self.http.url)
+        return self
+
+    def _warm_backend(self) -> None:
+        try:
+            import jax
+
+            n = len(jax.devices())
+            _log.info("[serve] backend warm: %d device(s)", n)
+        except Exception as e:  # no backend is a servable state (CPU
+            # tests stub jax out); admission just stays open
+            _log.warning("[serve] backend warmup failed: %s", e)
+        else:
+            # only now may admission touch the devices: decide() runs
+            # under the scheduler lock, so probes/reads must be
+            # cached-client lookups, never a blocking backend init
+            self.scheduler.admission.mark_backend_ready()
+
+    @property
+    def url(self) -> str:
+        return self.http.url
+
+    def serve_forever(self) -> None:
+        """Block until a shutdown request, then drain and stop.  (A
+        non-drain request already cancelled everything, so the drain
+        below finds an empty queue either way.)"""
+        self.scheduler.shutdown_requested.wait()
+        self.shutdown(drain=True)
+
+    def shutdown(self, drain: bool = True) -> None:
+        """Drain the scheduler, then stop the telemetry/job plane and the
+        server obs bundle.  Idempotent."""
+        if self._stopped.is_set():
+            return
+        self.scheduler.shutdown(drain=drain)
+        self.obs.finish(self._obs_config, "serve")
+        self._stopped.set()
+        _log.info("[serve] resident job server stopped")
+
+    # --- in-process submission (bench, tests, embedders) ------------------
+
+    def submit(self, workload: str, input_path: str, **kw):
+        return self.scheduler.submit(workload, input_path, **kw)
+
+    def wait(self, job_id: str, timeout: float | None = None):
+        return self.scheduler.wait(job_id, timeout=timeout)
+
+    def cancel(self, job_id: str, reason: str = "cancelled_by_client"):
+        return self.scheduler.cancel(job_id, reason=reason)
+
+
+def install_signal_handlers(server: ResidentServer) -> None:
+    """SIGTERM and SIGINT request a graceful drain (idempotent; a second
+    signal still just drains — running jobs finish inside the drain
+    budget, then are cancelled through the flight recorder)."""
+
+    def _drain(signum, _frame):
+        _log.info("[serve] signal %d: draining", signum)
+        server.scheduler.request_shutdown(drain=True)
+
+    signal.signal(signal.SIGTERM, _drain)
+    signal.signal(signal.SIGINT, _drain)

@@ -157,4 +157,52 @@ assert any(r["program"] == "shuffle/merge" for r in m["comms"]), \
     "comms table missing from the metrics document"
 print("final metrics doc carries series + comms tables")
 EOF
+
+echo "== serve smoke =="
+# resident job server on an ephemeral port: 3 identical small wordcounts
+# back to back must show compile/* deltas of ZERO after job 1 (the warm-
+# cache story, per-job compile-ledger accounting), /jobs must scrape
+# mid-run, and a client-requested drain must exit the server cleanly
+export MOXT_OBS_PORT_FILE="$smoke/serve_port.txt"
+rm -f "$smoke/serve_port.txt"
+JAX_PLATFORMS=cpu python -m map_oxidize_tpu serve --port 0 --workers 1 \
+    --spool-dir "$smoke/serve_spool" --quiet &
+serve_job=$!
+# a failed assertion below must not leak a resident server running
+# forever on the CI host (nor delete its live spool out from under it)
+trap 'kill "$serve_job" 2>/dev/null; rm -rf "$smoke"' EXIT
+python - "$smoke" <<'EOF'
+import sys, time
+d = sys.argv[1]
+deadline = time.monotonic() + 180
+port = None
+while time.monotonic() < deadline and port is None:
+    try:
+        port = int(open(f"{d}/serve_port.txt").read().split()[1])
+    except (OSError, IndexError, ValueError):
+        time.sleep(0.01)
+assert port, "serve port never appeared in MOXT_OBS_PORT_FILE"
+from map_oxidize_tpu.serve.client import ServeClient
+c = ServeClient(f"http://127.0.0.1:{port}")
+cfg = {"num_chunks": 16, "batch_size": 64, "num_shards": 1}
+ids = [c.submit("wordcount", f"{d}/corpus.txt", config=cfg,
+                output=f"{d}/serve_out.txt")["id"] for _ in range(3)]
+# mid-run /jobs scrape: all three submissions visible while the single
+# worker is still working the queue
+tbl = c.jobs()
+assert tbl["schema"] == "moxt-jobs-v1", tbl
+assert len(tbl["jobs"]) == 3 and tbl["queue"]["max"] == 16
+docs = [c.wait(i, timeout_s=120) for i in ids]
+assert [x["state"] for x in docs] == ["done"] * 3, docs
+assert docs[0]["compiles"] >= 1, docs[0]      # cold job compiled
+assert docs[1]["compiles"] == 0, docs[1]      # warm: zero deltas
+assert docs[2]["compiles"] == 0, docs[2]
+assert docs[0]["records_in"] == docs[2]["records_in"] == 1800
+print(f"serve OK: cold job compiled {docs[0]['compiles']}x, "
+      "warm compile deltas zero")
+c.shutdown(drain=True)
+EOF
+wait "$serve_job"   # exit 0 = clean drain on the client's shutdown
+trap 'rm -rf "$smoke"' EXIT
+unset MOXT_OBS_PORT_FILE
 echo "check.sh: ALL OK"

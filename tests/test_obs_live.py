@@ -523,6 +523,89 @@ def test_two_obs_context_isolation(tmp_path):
     assert obs_a.registry is not obs_b.registry
 
 
+def test_obs_context_reaches_prefetch_threads(tmp_path):
+    """Regression (ISSUE-7 satellite): the ContextVar bound by
+    ``Obs.recording`` does NOT inherit into spawned threads, so the
+    pipeline's producer thread used to observe under whatever job
+    activated last.  With the bind-on-spawn fix, dispatches made while
+    mapping IN THE PREFETCH THREAD route to the spawning job — two
+    concurrent jobs keep disjoint dispatch histograms."""
+    import jax
+    import jax.numpy as jnp
+
+    from map_oxidize_tpu.obs.compile import observed_jit
+    from map_oxidize_tpu.runtime.driver import run_wordcount_job
+    from map_oxidize_tpu.workloads.wordcount import make_wordcount
+
+    prog = observed_jit("ctx/prefetch_prog", jax.jit(lambda x: x * 2))
+    barrier = threading.Barrier(2)
+
+    class _DispatchingMapper:
+        """Delegates to the python mapper but dispatches a jitted
+        program per chunk — with ``num_map_workers=1`` and
+        ``pipeline_depth>1`` the inline map (and so the dispatch) runs
+        in the PREFETCH thread, not the driver thread."""
+
+        def __init__(self, inner):
+            self._inner = inner
+            self._first = True
+
+        def __getattr__(self, item):
+            return getattr(self._inner, item)
+
+        def map_chunk(self, chunk):
+            if self._first:
+                self._first = False
+                barrier.wait(timeout=60)  # both jobs demonstrably live
+            np.asarray(prog(jnp.arange(8)))
+            return self._inner.map_chunk(chunk)
+
+    chunks = {"a": 6, "b": 10}
+    grabbed: dict = {}
+    results: dict = {}
+
+    def _job(name):
+        corpus = tmp_path / f"{name}.txt"
+        _write_corpus(corpus, lines=40)
+        mapper, reducer = make_wordcount("ascii", use_native=False)
+        cfg = JobConfig(
+            input_path=str(corpus), output_path="", metrics=False,
+            num_chunks=chunks[name], num_map_workers=1,
+            pipeline_depth=3, batch_size=1 << 12,
+            key_capacity=1 << 12, mapper="python", use_native=False,
+        )
+        try:
+            results[name] = run_wordcount_job(
+                cfg, _DispatchingMapper(mapper), reducer,
+                on_obs=lambda obs: grabbed.__setitem__(name, obs))
+        except BaseException as e:  # pragma: no cover - surfaced below
+            results[name] = e
+
+    threads = [threading.Thread(target=_job, args=(n,)) for n in chunks]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    for name, r in results.items():
+        assert not isinstance(r, BaseException), (name, r)
+    ha = grabbed["a"].registry.histograms.get("device/dispatch_gap_ms")
+    hb = grabbed["b"].registry.histograms.get("device/dispatch_gap_ms")
+    assert ha is not None and hb is not None, \
+        "prefetch-thread dispatches did not reach the jobs' registries"
+    # ctx/prefetch_prog dispatches once per chunk; all but a possible
+    # compiling first call land in the job's OWN gap histogram — under
+    # the pre-fix fallback one registry would absorb both jobs'
+    # observations while the other starved
+    assert ha.count >= chunks["a"] - 1, (ha.count, hb.count)
+    assert hb.count >= chunks["b"] - 1, (ha.count, hb.count)
+    # decisive: the per-job xprof deltas (the overlay routed by
+    # ObsContext) attribute each job EXACTLY its own chunk count of
+    # prefetch-thread dispatches
+    na = results["a"].metrics.get("xprof/ctx/prefetch_prog/dispatches", 0)
+    nb = results["b"].metrics.get("xprof/ctx/prefetch_prog/dispatches", 0)
+    assert (na, nb) == (chunks["a"], chunks["b"])
+
+
 # --- 2-process Gloo: per-proc ports + proc-0 aggregate ---------------------
 
 _CHILD = r"""

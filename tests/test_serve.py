@@ -1,0 +1,509 @@
+"""Resident job service (ISSUE-7 tentpole): HBM admission control
+(admit/defer/reject with named reasons), cancel/deadline through the
+flight recorder, warm-compile multi-job serving with zero compile deltas
+after job 1, concurrent jobs with disjoint per-job obs/ledger state, the
+bounded queue, graceful drain, and the /jobs HTTP plane.
+
+Scheduler-level tests inject HELD runners (a threading.Event gates the
+job body) so admission and cancellation windows are deterministic; the
+HTTP/server tests drive real wordcount jobs through real drivers.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from map_oxidize_tpu.config import JobConfig, ServeConfig
+from map_oxidize_tpu.obs import Obs
+from map_oxidize_tpu.serve.admission import AdmissionController
+from map_oxidize_tpu.serve.corpus import CorpusCache
+from map_oxidize_tpu.serve.scheduler import Scheduler
+
+
+def _write_corpus(path, lines=200, words=None):
+    words = words or [b"alpha", b"beta", b"gamma", b"delta"]
+    rng = np.random.default_rng(11)
+    with open(path, "wb") as f:
+        for _ in range(lines):
+            f.write(b" ".join(words[int(i)]
+                              for i in rng.integers(0, len(words), 8))
+                    + b"\n")
+    return str(path)
+
+
+def _serve_cfg(tmp_path, **kw) -> ServeConfig:
+    kw.setdefault("port", 0)
+    kw.setdefault("spool_dir", str(tmp_path / "spool"))
+    kw.setdefault("job_sample_s", 0.05)
+    kw.setdefault("drain_timeout_s", 5.0)
+    return ServeConfig(**kw).validate()
+
+
+def _held_runner(release: threading.Event):
+    """A runner whose job body blocks on ``release`` inside a real
+    ``Obs.recording`` envelope, polling the cancellation point — the
+    deterministic stand-in for a long-running driver."""
+
+    def run(config, workload, on_obs):
+        obs = Obs.from_config(config)
+        on_obs(obs)
+        with obs.recording(config, workload):
+            obs.registry.count("held/progress", 1)
+            while not release.wait(0.01):
+                obs.poll_cancel()
+        obs.finish(config, workload)
+
+        class _R:
+            metrics = {"records_in": 1}
+
+        return _R()
+
+    return run
+
+
+# --- config + admission units ----------------------------------------------
+
+
+def test_serve_config_validates():
+    with pytest.raises(ValueError):
+        ServeConfig(workers=0).validate()
+    with pytest.raises(ValueError):
+        ServeConfig(max_queue=0).validate()
+    with pytest.raises(ValueError):
+        ServeConfig(port=70000).validate()
+    with pytest.raises(ValueError):
+        ServeConfig(hbm_budget_bytes=-1).validate()
+    with pytest.raises(ValueError):   # 0 would 404 every finished job
+        ServeConfig(max_history=0).validate()
+    assert ServeConfig().validate().workers >= 1
+
+
+def test_admission_decisions():
+    adm = AdmissionController(budget_bytes=1000)
+    assert adm.decide(400) == ("admit", "")
+    decision, reason = adm.decide(2000)
+    assert decision == "reject"
+    assert "working_set_exceeds_hbm_budget" in reason
+    adm.reserve(700)
+    decision, reason = adm.decide(400)
+    assert decision == "defer"
+    assert "hbm_budget_busy" in reason
+    adm.release(700)
+    assert adm.decide(400)[0] == "admit"
+    # zero budget (unprobeable backend) leaves admission open
+    assert AdmissionController(0).decide(1 << 50)[0] == "admit"
+
+
+def test_corpus_cache_idle_eviction(tmp_path):
+    clock = [0.0]
+    cache = CorpusCache(idle_evict_s=10.0, clock=lambda: clock[0])
+    path = _write_corpus(tmp_path / "c.txt", lines=5)
+    size = cache.open(path)
+    assert size == os.path.getsize(path) and path in cache
+    with pytest.raises(OSError):
+        cache.open(str(tmp_path / "missing.txt"))
+    clock[0] = 9.0
+    assert cache.evict_idle() == 0 and len(cache) == 1
+    cache.touch(path)            # a job touch resets the idle clock
+    clock[0] = 18.0
+    assert cache.evict_idle() == 0
+    clock[0] = 30.0
+    assert cache.evict_idle() == 1 and len(cache) == 0
+    assert cache.evictions == 1
+
+
+# --- scheduler: admission, queue bound, cancel/deadline, drain --------------
+
+
+def test_oversized_job_rejected_named(tmp_path):
+    corpus = _write_corpus(tmp_path / "c.txt")
+    sched = Scheduler(_serve_cfg(tmp_path, hbm_budget_bytes=1 << 20),
+                      runner=_held_runner(threading.Event()))
+    sched.start()
+    try:
+        job = sched.submit("wordcount", corpus, est_hbm_bytes=2 << 20)
+        assert job.state == "rejected"
+        assert "working_set_exceeds_hbm_budget" in job.reason
+        # a rejection is a named refusal, not a capacity abort: no crash
+        # bundle, no job dir
+        assert not os.path.isdir(os.path.join(sched.cfg.spool_dir, job.id))
+    finally:
+        sched.shutdown()
+
+
+def test_deferred_job_runs_after_hbm_frees(tmp_path):
+    corpus = _write_corpus(tmp_path / "c.txt")
+    release = threading.Event()
+    sched = Scheduler(_serve_cfg(tmp_path, hbm_budget_bytes=1000,
+                                 workers=2),
+                      runner=_held_runner(release))
+    sched.start()
+    try:
+        a = sched.submit("wordcount", corpus, est_hbm_bytes=700)
+        deadline = time.monotonic() + 30
+        while a.state != "running" and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert a.state == "running"
+        b = sched.submit("wordcount", corpus, est_hbm_bytes=600)
+        # b cannot fit next to a: deferred (still queued), reason named
+        deadline = time.monotonic() + 30
+        while b.defer_reason is None and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert b.state == "queued"
+        assert "hbm_budget_busy" in b.defer_reason
+        assert sched.job_doc(b.id)["reason"] == b.defer_reason
+        release.set()            # a finishes -> HBM frees -> b admitted
+        assert sched.wait(a.id, timeout=30).state == "done"
+        assert sched.wait(b.id, timeout=30).state == "done"
+    finally:
+        release.set()
+        sched.shutdown()
+
+
+def test_queue_bound_rejects_named(tmp_path):
+    corpus = _write_corpus(tmp_path / "c.txt")
+    release = threading.Event()
+    sched = Scheduler(_serve_cfg(tmp_path, workers=1, max_queue=1),
+                      runner=_held_runner(release))
+    sched.start()
+    try:
+        a = sched.submit("wordcount", corpus)
+        deadline = time.monotonic() + 30
+        while a.state != "running" and time.monotonic() < deadline:
+            time.sleep(0.01)
+        b = sched.submit("wordcount", corpus)   # fills the queue
+        c = sched.submit("wordcount", corpus)   # past the bound
+        assert b.state == "queued"
+        assert c.state == "rejected" and "queue_full" in c.reason
+        release.set()
+        assert sched.wait(b.id, timeout=30).state == "done"
+    finally:
+        release.set()
+        sched.shutdown()
+
+
+def test_submit_validation_errors(tmp_path):
+    sched = Scheduler(_serve_cfg(tmp_path),
+                      runner=_held_runner(threading.Event()))
+    corpus = _write_corpus(tmp_path / "c.txt")
+    try:
+        with pytest.raises(ValueError, match="unknown workload"):
+            sched.submit("terasort", corpus)
+        with pytest.raises(ValueError, match="reserved"):
+            sched.submit("wordcount", corpus,
+                         overrides={"metrics_out": "/tmp/x"})
+        with pytest.raises(ValueError, match="unknown config"):
+            sched.submit("wordcount", corpus, overrides={"nope": 1})
+        with pytest.raises(ValueError):     # JobConfig.validate refuses
+            sched.submit("wordcount", corpus,
+                         overrides={"batch_size": -1})
+        missing = sched.submit("wordcount", str(tmp_path / "missing.txt"))
+        assert missing.state == "rejected"
+        assert "input_not_found" in missing.reason
+    finally:
+        sched.shutdown()
+
+
+def test_rejected_history_stays_bounded(tmp_path):
+    """A retry storm of rejections while nothing completes must not grow
+    the job history unboundedly — rejections are terminal and prune."""
+    sched = Scheduler(_serve_cfg(tmp_path, max_history=5),
+                      runner=_held_runner(threading.Event()))
+    try:
+        for _ in range(25):   # every one rejects: input does not exist
+            job = sched.submit("wordcount", str(tmp_path / "missing.txt"))
+            assert job.state == "rejected"
+        assert len(sched.job_ids()) <= 6   # cap + the newest rejection
+    finally:
+        sched.shutdown()
+
+
+def test_wait_unknown_job_raises_named_keyerror(tmp_path):
+    sched = Scheduler(_serve_cfg(tmp_path),
+                      runner=_held_runner(threading.Event()))
+    try:
+        with pytest.raises(KeyError, match="job-9999"):
+            sched.wait("job-9999", timeout=1)
+    finally:
+        sched.shutdown()
+
+
+def test_worker_slot_survives_base_exception(tmp_path):
+    """A job body raising a BaseException (SystemExit here — the shape a
+    pipeline kill-resume re-raise takes) fails THAT job but must not
+    kill the worker slot: the next job still runs."""
+    corpus = _write_corpus(tmp_path / "c.txt")
+    boom = {"armed": True}
+    release = threading.Event()
+    release.set()                     # the healthy job finishes at once
+
+    def runner(config, workload, on_obs):
+        if boom.pop("armed", False):
+            raise SystemExit("job body bailed")
+        return _held_runner(release)(config, workload, on_obs)
+
+    sched = Scheduler(_serve_cfg(tmp_path, workers=1), runner=runner)
+    sched.start()
+    try:
+        bad = sched.submit("wordcount", corpus)
+        assert sched.wait(bad.id, timeout=30).state == "failed"
+        assert "SystemExit" in bad.reason
+        ok = sched.submit("wordcount", corpus)
+        assert sched.wait(ok.id, timeout=30).state == "done"
+    finally:
+        sched.shutdown()
+
+
+def test_submit_cli_choices_track_served_workloads():
+    """The submit CLI's workload choices come from the same allowlist
+    the scheduler enforces — one source of truth in config.py."""
+    from map_oxidize_tpu.config import SERVE_WORKLOADS
+    from map_oxidize_tpu.serve.cli import build_submit_parser
+
+    action = next(a for a in build_submit_parser()._actions
+                  if a.dest == "workload")
+    assert tuple(action.choices) == SERVE_WORKLOADS
+
+
+def test_cancel_running_job_flight_recorded(tmp_path):
+    corpus = _write_corpus(tmp_path / "c.txt")
+    release = threading.Event()
+    sched = Scheduler(_serve_cfg(tmp_path), runner=_held_runner(release))
+    sched.start()
+    try:
+        job = sched.submit("wordcount", corpus)
+        deadline = time.monotonic() + 30
+        while job.state != "running" and time.monotonic() < deadline:
+            time.sleep(0.01)
+        sched.cancel(job.id, reason="cancelled_by_client")
+        done = sched.wait(job.id, timeout=30)
+        assert done.state == "cancelled"
+        assert done.reason == "cancelled_by_client"
+        # the cancel took the flight path: partial obs flushed as a
+        # crash bundle AND the partial metrics doc, with the work so far
+        crash = done.config.crash_dir
+        bundles = os.listdir(crash)
+        assert len(bundles) == 1
+        doc = json.loads(open(os.path.join(
+            crash, bundles[0], "metrics.json")).read())
+        assert doc["counters"]["held/progress"] == 1
+        assert doc["gauges"]["aborted"] is True
+        err = json.loads(open(os.path.join(
+            crash, bundles[0], "error.json")).read())
+        assert "JobCancelled" in err["error"]
+    finally:
+        release.set()
+        sched.shutdown()
+
+
+def test_deadline_cancels_running_job(tmp_path):
+    corpus = _write_corpus(tmp_path / "c.txt")
+    release = threading.Event()
+    sched = Scheduler(_serve_cfg(tmp_path), runner=_held_runner(release))
+    sched.start()
+    try:
+        job = sched.submit("wordcount", corpus, deadline_s=0.3)
+        done = sched.wait(job.id, timeout=30)
+        assert done.state == "cancelled"
+        assert done.reason == "deadline_exceeded"
+        assert os.listdir(done.config.crash_dir)  # flight bundle flushed
+    finally:
+        release.set()
+        sched.shutdown()
+
+
+def test_cancel_queued_job_immediate(tmp_path):
+    corpus = _write_corpus(tmp_path / "c.txt")
+    release = threading.Event()
+    sched = Scheduler(_serve_cfg(tmp_path, workers=1),
+                      runner=_held_runner(release))
+    sched.start()
+    try:
+        a = sched.submit("wordcount", corpus)
+        b = sched.submit("wordcount", corpus)
+        sched.cancel(b.id)
+        assert b.state == "cancelled"       # never ran: no bundle dir
+        assert not os.path.isdir(os.path.join(b.config.crash_dir))
+        release.set()
+        assert sched.wait(a.id, timeout=30).state == "done"
+    finally:
+        release.set()
+        sched.shutdown()
+
+
+def test_drain_finishes_running_rejects_new(tmp_path):
+    corpus = _write_corpus(tmp_path / "c.txt")
+    release = threading.Event()
+    sched = Scheduler(_serve_cfg(tmp_path, workers=1),
+                      runner=_held_runner(release))
+    sched.start()
+    job = sched.submit("wordcount", corpus)
+    deadline = time.monotonic() + 30
+    while job.state != "running" and time.monotonic() < deadline:
+        time.sleep(0.01)
+    sched.request_shutdown(drain=True)
+    late = sched.submit("wordcount", corpus)
+    assert late.state == "rejected" and "server_draining" in late.reason
+    release.set()
+    sched.shutdown()                 # drains: the running job FINISHES
+    assert job.state == "done"
+    doc = sched.jobs_doc()
+    assert doc["draining"] is True
+    assert doc["counts"] == {"done": 1, "rejected": 1}
+
+
+# --- real jobs through the resident server (HTTP plane) ---------------------
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    from map_oxidize_tpu.serve.server import ResidentServer
+
+    tmp = tmp_path_factory.mktemp("serve")
+    cfg = ServeConfig(port=0, workers=2, spool_dir=str(tmp / "spool"),
+                      job_sample_s=0.05, drain_timeout_s=10.0).validate()
+    srv = ResidentServer(cfg).start()
+    yield srv, tmp
+    srv.shutdown()
+
+
+@pytest.fixture(scope="module")
+def client(server):
+    from map_oxidize_tpu.serve.client import ServeClient
+
+    srv, _tmp = server
+    return ServeClient(srv.url)
+
+
+def _job_overrides():
+    # python mapper + pinned single shard: no native dep, no mesh init,
+    # and the prefetch pipeline stays on (depth default 2)
+    return {"num_chunks": 6, "batch_size": 1 << 12,
+            "key_capacity": 1 << 12, "num_map_workers": 1,
+            "mapper": "python", "use_native": False, "num_shards": 1}
+
+
+def test_warm_jobs_zero_compile_delta(server, client, tmp_path):
+    """N back-to-back same-shape jobs through the server: every job after
+    the first shows a ZERO per-job compile delta (the warm-cache story,
+    per-job accounting via the compile-ledger overlay)."""
+    _srv, tmp = server
+    corpus = _write_corpus(tmp / "warm.txt", lines=300)
+    docs = []
+    for _ in range(3):
+        doc = client.submit("wordcount", corpus, config=_job_overrides())
+        docs.append(client.wait(doc["id"], timeout_s=120))
+    assert [d["state"] for d in docs] == ["done"] * 3
+    assert all(d["records_in"] == docs[0]["records_in"] for d in docs)
+    # job 1 may or may not compile (this pytest process may be warm
+    # already); jobs 2..N must not compile ANYTHING
+    assert docs[1]["compiles"] == 0
+    assert docs[2]["compiles"] == 0
+    # the full per-program evidence rides the job's metrics doc
+    m = json.loads(open(docs[1]["artifacts"]["metrics_out"]).read())
+    assert m["gauges"]["compile/total_compiles"] == 0
+
+
+def test_concurrent_jobs_oracle_exact_disjoint(server, client):
+    """Two jobs at once through the 2-worker server: oracle-exact
+    outputs, disjoint per-job metrics docs and ledger entries."""
+    from map_oxidize_tpu.obs import ledger
+    from map_oxidize_tpu.workloads.reference_model import wordcount_model
+
+    srv, tmp = server
+    ca = _write_corpus(tmp / "ca.txt", lines=150,
+                       words=[b"aa", b"bb", b"cc"])
+    cb = _write_corpus(tmp / "cb.txt", lines=250,
+                       words=[b"xx", b"yy", b"zz", b"ww"])
+    out_a = str(tmp / "out_a.txt")
+    out_b = str(tmp / "out_b.txt")
+    da = client.submit("wordcount", ca, config=_job_overrides(),
+                       output=out_a)
+    db = client.submit("wordcount", cb, config=_job_overrides(),
+                       output=out_b)
+    da = client.wait(da["id"], timeout_s=120)
+    db = client.wait(db["id"], timeout_s=120)
+    assert da["state"] == "done" and db["state"] == "done"
+    for corpus, out in ((ca, out_a), (cb, out_b)):
+        with open(corpus, "rb") as f:
+            oracle = wordcount_model([f.read()])
+        got = {}
+        with open(out, "rb") as f:
+            for line in f:
+                w, _, n = line.rstrip(b"\n").rpartition(b" ")
+                got[w] = int(n)
+        assert got == dict(oracle), f"output mismatch for {corpus}"
+    # disjoint metrics docs: each job's doc counts ITS corpus only
+    ma = json.loads(open(da["artifacts"]["metrics_out"]).read())
+    mb = json.loads(open(db["artifacts"]["metrics_out"]).read())
+    assert ma["gauges"]["records_in"] == da["records_in"]
+    assert mb["gauges"]["records_in"] == db["records_in"]
+    assert da["records_in"] != db["records_in"]
+    # ...and each job appended its own ledger entry
+    entries = ledger.read(srv.scheduler.ledger_dir)
+    by_rec = {e["metrics"]["records_in"] for e in entries}
+    assert {da["records_in"], db["records_in"]} <= by_rec
+
+
+def test_jobs_table_and_render(server, client):
+    from map_oxidize_tpu.obs.cli import render_jobs
+
+    doc = client.jobs()
+    assert doc["schema"] == "moxt-jobs-v1"
+    assert doc["queue"]["max"] == 16
+    assert doc["counts"].get("done", 0) >= 2
+    assert {"budget_bytes", "reserved_bytes",
+            "measured_live_bytes"} <= set(doc["hbm"])
+    assert any(c["hits"] >= 1 for c in doc["corpora"])
+    frame = render_jobs(doc)
+    assert "jobs (" in frame
+    assert doc["jobs"][0]["id"] in frame
+    # the index advertises the job plane
+    idx = client._request("/")
+    assert "/jobs" in idx["endpoints"]
+
+
+def test_http_submit_validation(server, client):
+    from map_oxidize_tpu.serve.client import ServeError
+
+    _srv, tmp = server
+    with pytest.raises(ServeError, match="unknown workload"):
+        client.submit("terasort", str(tmp / "warm.txt"))
+    with pytest.raises(ServeError, match="reserved"):
+        client.submit("wordcount", str(tmp / "warm.txt"),
+                      config={"obs_port": 5})
+    with pytest.raises(ServeError, match="unknown job"):
+        client.cancel("job-9999")
+    rejected = client.submit("wordcount", str(tmp / "nope.txt"))
+    assert rejected["state"] == "rejected"
+    assert "input_not_found" in rejected["reason"]
+
+
+def test_http_shutdown_requests_drain(tmp_path):
+    """POST /shutdown flips the scheduler to draining and wakes
+    serve_forever, which drains and stops the plane."""
+    from map_oxidize_tpu.serve.client import ServeClient
+    from map_oxidize_tpu.serve.server import ResidentServer
+
+    release = threading.Event()
+    release.set()
+    srv = ResidentServer(_serve_cfg(tmp_path, workers=1),
+                         runner=_held_runner(release)).start()
+    c = ServeClient(srv.url)
+    corpus = _write_corpus(tmp_path / "c.txt", lines=5)
+    done = c.wait(c.submit("wordcount", corpus)["id"], timeout_s=30)
+    assert done["state"] == "done"
+    assert c.shutdown(drain=True)["draining"] is True
+    t = threading.Thread(target=srv.serve_forever)
+    t.start()
+    t.join(timeout=30)
+    assert not t.is_alive()
+    import urllib.error
+    import urllib.request
+
+    with pytest.raises((urllib.error.URLError, OSError)):
+        urllib.request.urlopen(srv.url + "/jobs", timeout=2)
