@@ -42,6 +42,21 @@ class JobConfig:
     #: pipeline preserves chunk order); each extra unit of depth holds at
     #: most one more chunk's MapOutput in host memory
     pipeline_depth: int = 2
+    #: dispatch batching on streamed paths: logical chunks retired per
+    #: device launch.  The streamed k-means step wraps its per-chunk body
+    #: in a ``lax.scan`` over a stacked ``(B, chunk_rows, d)`` block, and
+    #: the packed fold-engine merge scans B staged feed batches per
+    #: dispatch — amortizing the measured ~150-250 ms/launch floor by B.
+    #: 0 = auto: picked at job start from the measured dispatch floor,
+    #: host-produce and device-compute per chunk (xprof roofline data),
+    #: capped by the HBM budget; the chosen B and its inputs are recorded
+    #: in metrics and the run ledger.  1 = the unbatched schedule; N > 1
+    #: pins the batch.  Outputs are bit-identical at any B (tail chunks
+    #: are zero-weight-masked; accumulation order is preserved), and B is
+    #: deliberately NOT checkpoint or ledger identity — a job may resume
+    #: or gate across different B.  The fold engine batches only under an
+    #: explicit N > 1 (auto targets the streamed k-means dispatch).
+    dispatch_batch: int = 0
     #: hard upper bound on distinct keys on device (accumulator max size)
     key_capacity: int = 1 << 22
     #: starting accumulator capacity; grows by sentinel-padding (4x steps)
@@ -196,6 +211,10 @@ class JobConfig:
             raise ValueError("chunk_bytes must be positive (or set num_chunks)")
         if self.pipeline_depth < 1:
             raise ValueError("pipeline_depth must be >= 1 (1 = serial)")
+        if not 0 <= self.dispatch_batch <= 1024:
+            raise ValueError(
+                "dispatch_batch must be 0 (auto) or 1..1024 chunks per "
+                f"dispatch, got {self.dispatch_batch}")
         if self.kmeans_device_fit_bytes < 0:
             raise ValueError(
                 "kmeans_device_fit_bytes must be >= 0 (0 = probe the device)")

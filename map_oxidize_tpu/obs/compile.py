@@ -52,7 +52,7 @@ class ProgramStats:
 
     __slots__ = ("name", "compiles", "compile_ms", "backend_compile_ms",
                  "dispatches", "dispatch_ms", "sampled_ms", "samples",
-                 "causes", "sigs", "flops", "bytes_accessed")
+                 "causes", "sigs", "flops", "bytes_accessed", "chunks")
 
     def __init__(self, name: str):
         self.name = name
@@ -63,6 +63,10 @@ class ProgramStats:
         self.dispatch_ms = 0.0         # host handoff -> async return
         self.sampled_ms = 0.0          # sampled post-return ready waits
         self.samples = 0
+        #: logical chunks retired by the NON-compiling dispatches (a
+        #: scan-batched program retires B chunks per launch; matches the
+        #: dispatch_ms population so per-chunk gap = dispatch_ms/chunks)
+        self.chunks = 0
         self.causes: list[str] = []
         #: signature -> (flops, bytes) cost from Lowered.cost_analysis
         self.sigs: dict = {}
@@ -73,7 +77,7 @@ class ProgramStats:
     def snapshot(self) -> tuple:
         return (self.compiles, self.compile_ms, self.backend_compile_ms,
                 self.dispatches, self.dispatch_ms, self.sampled_ms,
-                self.samples, len(self.causes))
+                self.samples, len(self.causes), self.chunks)
 
 
 class CompileLedger:
@@ -160,7 +164,7 @@ class CompileLedger:
                 "compiles": 0, "compile_ms": 0.0,
                 "backend_compile_ms": 0.0, "dispatches": 0,
                 "dispatch_ms": 0.0, "sampled_ms": 0.0, "samples": 0,
-                "causes": []}
+                "chunks": 0, "causes": []}
         return row
 
     # --- recording (called from ObservedJit) ------------------------------
@@ -237,14 +241,25 @@ class CompileLedger:
                 _log.warning("%s", line)
 
     def record_dispatch(self, stats: ProgramStats, gap_ms: float,
-                        ready_ms: float | None, compiled: bool) -> None:
+                        ready_ms: float | None, compiled: bool,
+                        chunks: int = 1, batched: bool = False) -> None:
         """A compiling call's wall is compile time, not dispatch gap — it
         is excluded from the gap histogram and the per-program dispatch
-        wall so steady-state overhead and rate estimates stay clean."""
+        wall so steady-state overhead and rate estimates stay clean.
+
+        ``chunks`` is the number of REAL logical chunks this one dispatch
+        retired (a scan-batched program covers up to B; a padded tail
+        block fewer): it accumulates next to the dispatch wall, and
+        dispatches of a ``batched`` program (one that declares its chunk
+        count) additionally land a ``device/dispatch_gap_per_chunk_ms``
+        observation (gap / chunks) so dispatch-overhead histograms stay
+        comparable across B — including the tail dispatch whose single
+        real chunk pays the whole launch gap."""
         with self._lock:
             stats.dispatches += 1
             if not compiled:
                 stats.dispatch_ms += gap_ms
+                stats.chunks += chunks
             if ready_ms is not None:
                 stats.sampled_ms += ready_ms
                 stats.samples += 1
@@ -255,12 +270,16 @@ class CompileLedger:
                 row["dispatches"] += 1
                 if not compiled:
                     row["dispatch_ms"] += gap_ms
+                    row["chunks"] += chunks
                 if ready_ms is not None:
                     row["sampled_ms"] += ready_ms
                     row["samples"] += 1
         if obs is not None:
             if not compiled:
                 obs.registry.observe("device/dispatch_gap_ms", gap_ms)
+                if batched:
+                    obs.registry.observe("device/dispatch_gap_per_chunk_ms",
+                                         gap_ms / chunks)
             if ready_ms is not None:
                 obs.registry.observe("device/compute_ms", ready_ms)
 
@@ -296,6 +315,7 @@ class CompileLedger:
                     "dispatch_ms": round(row["dispatch_ms"], 3),
                     "sampled_device_ms": round(row["sampled_ms"], 3),
                     "device_samples": row["samples"],
+                    "logical_chunks": row.get("chunks", 0),
                     "recompile_causes": list(row["causes"]),
                     "shape_sets": len(p.sigs) if p is not None else 0,
                     "flops_per_dispatch": p.flops if p else None,
@@ -303,7 +323,7 @@ class CompileLedger:
                 }
             return out
         for name, p in items:
-            b = baseline.get(name, (0, 0.0, 0.0, 0, 0.0, 0.0, 0, 0))
+            b = baseline.get(name, (0, 0.0, 0.0, 0, 0.0, 0.0, 0, 0, 0))
             compiles = p.compiles - b[0]
             dispatches = p.dispatches - b[3]
             if compiles <= 0 and dispatches <= 0:
@@ -316,6 +336,7 @@ class CompileLedger:
                 "dispatch_ms": round(p.dispatch_ms - b[4], 3),
                 "sampled_device_ms": round(p.sampled_ms - b[5], 3),
                 "device_samples": p.samples - b[6],
+                "logical_chunks": p.chunks - (b[8] if len(b) > 8 else 0),
                 "recompile_causes": p.causes[b[7]:],
                 "shape_sets": len(p.sigs),
                 "flops_per_dispatch": p.flops,
@@ -376,7 +397,7 @@ class ObservedJit:
     """
 
     def __init__(self, name: str, fn, tag=None, ledger: CompileLedger = None,
-                 sample_every: int = SAMPLE_EVERY):
+                 sample_every: int = SAMPLE_EVERY, chunks_of=None):
         self._name = name
         self._fn = fn
         #: extra static identity folded into the signature (e.g. the
@@ -384,6 +405,11 @@ class ObservedJit:
         self._tag = tag
         self._ledger = ledger if ledger is not None else LEDGER
         self._sample_every = sample_every
+        #: optional ``(args, kw) -> int``: how many LOGICAL chunks one
+        #: dispatch of this program retires (a scan-batched program
+        #: covers B per launch) — drives the per-logical-chunk
+        #: dispatch-gap attribution; None = 1 chunk per dispatch
+        self._chunks_of = chunks_of
         self._ledger._ensure_listener()
 
     def __getattr__(self, item):
@@ -399,6 +425,13 @@ class ObservedJit:
     def __call__(self, *args, **kw):
         import jax
 
+        # reserved kwarg, consumed here (never forwarded to the jitted
+        # fn): the REAL logical-chunk count of this dispatch, for call
+        # sites whose padded block carries dead chunks the static
+        # chunks_of shape cannot see (a tail block / padded drain) —
+        # keeps per-chunk attribution consistent with the comms
+        # accounting, which also excludes dead chunks
+        explicit_chunks = kw.pop("observed_chunks", None)
         if any(isinstance(leaf, jax.core.Tracer)
                for leaf in jax.tree_util.tree_leaves((args, kw))):
             # called inside another program's trace: it inlines there and
@@ -409,6 +442,16 @@ class ObservedJit:
         sig = _sig_of(args, kw)
         if self._tag is not None:
             sig = sig + (("v", repr(self._tag)),)
+        chunks = 1
+        if explicit_chunks is not None:
+            chunks = max(1, int(explicit_chunks))
+        elif self._chunks_of is not None:
+            # read the chunk count BEFORE the call: shapes survive
+            # donation, but before-call is unconditionally safe
+            try:
+                chunks = max(1, int(self._chunks_of(*args, **kw)))
+            except Exception:
+                chunks = 1
         cost = None
         # the seen-set is ledger-level (keyed by program NAME): a fresh
         # per-job jit closure of the same program re-compiling the same
@@ -477,7 +520,10 @@ class ObservedJit:
                 ready_ms = (time.perf_counter() - t1) * 1e3
             except Exception:
                 ready_ms = None
-        led.record_dispatch(stats, gap_ms, ready_ms, compiled)
+        led.record_dispatch(stats, gap_ms, ready_ms, compiled,
+                            chunks=chunks,
+                            batched=(explicit_chunks is not None
+                                     or self._chunks_of is not None))
         return out
 
 
@@ -498,9 +544,12 @@ def job_overlay_delta(obs) -> dict:
     return LEDGER.job_delta(base, local)
 
 
-def observed_jit(name: str, fn, tag=None) -> ObservedJit:
+def observed_jit(name: str, fn, tag=None, chunks_of=None) -> ObservedJit:
     """Observe an already-jitted callable under a stable program name.
     The name is the join key for everything downstream — compile counts,
     recompile causes, cost/MFU rows, the ``obs xprof`` table, and the
-    ledger gate — so it must be stable across runs (no per-job salt)."""
-    return ObservedJit(name, fn, tag=tag)
+    ledger gate — so it must be stable across runs (no per-job salt).
+    ``chunks_of(args...) -> int`` declares how many logical chunks one
+    dispatch retires (scan-batched programs), for per-chunk dispatch-gap
+    attribution."""
+    return ObservedJit(name, fn, tag=tag, chunks_of=chunks_of)

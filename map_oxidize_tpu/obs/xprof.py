@@ -109,6 +109,16 @@ def job_report(delta: dict) -> dict:
             dev_s = d["dispatch_ms"] / 1e3
             row["device_time_source"] = "dispatch_wall"
         row["device_s_est"] = round(dev_s, 6) if dev_s else None
+        # per-LOGICAL-chunk dispatch attribution: a scan-batched program
+        # retires B chunks per launch, so its per-dispatch gap is not
+        # comparable across B — gap / logical chunks is (the number the
+        # dispatch-floor trajectory tracks)
+        ch = d.get("logical_chunks") or 0
+        if ch and d["dispatch_ms"] > 0:
+            row["chunks_per_dispatch"] = round(
+                ch / max(n - d["compiles"], 1), 2)
+            row["dispatch_gap_per_chunk_ms"] = round(
+                d["dispatch_ms"] / ch, 4)
         if n and flops and dev_s:
             row["achieved_flops_per_s"] = round(flops * n / dev_s, 1)
             if peaks["flops"]:
@@ -154,6 +164,13 @@ def flatten_report(report: dict) -> dict:
                        ("bound", "bound")):
             if row.get(k) is not None:
                 out[f"xprof/{name}/{dst}"] = row[k]
+        # per-logical-chunk attribution (an unbatched program retires 1
+        # chunk/dispatch, so for it this equals the mean dispatch gap —
+        # the value stays comparable when the same program later batches)
+        if row.get("dispatch_gap_per_chunk_ms") is not None:
+            out[f"xprof/{name}/logical_chunks"] = row["logical_chunks"]
+            out[f"xprof/{name}/dispatch_gap_per_chunk_ms"] = \
+                row["dispatch_gap_per_chunk_ms"]
     return out
 
 
@@ -207,7 +224,8 @@ def render_report(report: dict, histograms: dict | None = None) -> str:
             f"{r.get('mfu_pct', '-'):>6} {r.get('membw_pct', '-'):>6}  "
             f"{r.get('bound', '-')}")
     if histograms:
-        for h in ("device/dispatch_gap_ms", "device/compute_ms"):
+        for h in ("device/dispatch_gap_ms",
+                  "device/dispatch_gap_per_chunk_ms", "device/compute_ms"):
             s = histograms.get(h)
             if s:
                 lines.append(

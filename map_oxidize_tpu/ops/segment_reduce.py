@@ -135,6 +135,25 @@ def make_accumulator(capacity: int, val_shape=(), val_dtype=jnp.int32,
     return hi, lo, vals
 
 
+def _merge_impl(acc_hi, acc_lo, acc_vals, ovf, b_hi, b_lo, b_vals,
+                combine="sum"):
+    """Raw (unjitted) merge body shared by every merge program: fold one
+    batch into the running accumulator.
+
+    Concatenate accumulator (capacity C) with batch (size B), reduce, keep
+    the first C rows.  ``ovf`` is a cumulative dropped-key counter carried
+    through every merge: keys truncated past C add to it, so a later clean
+    merge can never shadow an earlier loss and an *exactly full*
+    accumulator is not an error."""
+    cap = acc_hi.shape[0]
+    hi = jnp.concatenate([acc_hi, b_hi])
+    lo = jnp.concatenate([acc_lo, b_lo])
+    vals = jnp.concatenate([acc_vals, b_vals])
+    u_hi, u_lo, u_vals, n_unique = reduce_pairs(hi, lo, vals, combine)
+    ovf = ovf + jnp.maximum(n_unique - cap, 0)
+    return u_hi[:cap], u_lo[:cap], u_vals[:cap], n_unique, ovf
+
+
 @partial(observed_jit, "engine/merge_packed")
 @partial(jax.jit, static_argnames=("combine",), donate_argnums=(0, 1, 2, 3, 4))
 def merge_packed_into_accumulator(acc_hi, acc_lo, acc_vals, ovf, packed,
@@ -146,8 +165,43 @@ def merge_packed_into_accumulator(acc_hi, acc_lo, acc_vals, ovf, packed,
     packed put beats three plane puts."""
     b_hi, b_lo = packed[0], packed[1]
     b_vals = lax.bitcast_convert_type(packed[2], jnp.int32)
-    return merge_into_accumulator(acc_hi, acc_lo, acc_vals, ovf,
-                                  b_hi, b_lo, b_vals, combine=combine)
+    return _merge_impl(acc_hi, acc_lo, acc_vals, ovf,
+                       b_hi, b_lo, b_vals, combine=combine)
+
+
+def _merge_packed_batch(acc_hi, acc_lo, acc_vals, ovf, stacked,
+                        combine="sum"):
+    """Scan-batched packed merge: fold ``stacked`` — B packed ``(3, N)``
+    feed batches stacked into one ``(B, 3, N)`` transfer — with a
+    ``lax.scan`` of the SAME merge body the single-batch program runs.
+    One launch and one host->device put retire B merges (the fold-engine
+    half of the dispatch-floor attack, ROADMAP open item 3); the scan
+    carries the accumulator sequentially, so the result is byte-identical
+    to B separate merges in the same order."""
+
+    def body(carry, packed):
+        hi, lo, vals, o = carry
+        b_vals = lax.bitcast_convert_type(packed[2], jnp.int32)
+        hi, lo, vals, n, o = _merge_impl(hi, lo, vals, o, packed[0],
+                                         packed[1], b_vals, combine=combine)
+        return (hi, lo, vals, o), n
+
+    (acc_hi, acc_lo, acc_vals, ovf), ns = lax.scan(
+        body, (acc_hi, acc_lo, acc_vals, ovf), stacked)
+    return acc_hi, acc_lo, acc_vals, ns[-1], ovf
+
+
+#: jitted+observed form of :func:`_merge_packed_batch`; the per-dispatch
+#: gap is attributed per logical merge (``chunks_of``: the stacked B).
+#: The stacked transfer (arg 4) is NOT donated: its (B, 3, feed_batch)
+#: shape can alias none of the capacity-shaped outputs, so donating it
+#: would only warn — dropping the host reference after the call is what
+#: frees it.
+merge_packed_batch_into_accumulator = observed_jit(
+    "engine/merge_packed_batch",
+    jax.jit(_merge_packed_batch, static_argnames=("combine",),
+            donate_argnums=(0, 1, 2, 3)),
+    chunks_of=lambda *a, **kw: a[4].shape[0])
 
 
 @partial(observed_jit, "engine/pack_finalize")
@@ -169,18 +223,9 @@ def pack_accumulator_state(acc_hi, acc_lo, acc_vals, n_unique, ovf):
 @partial(jax.jit, static_argnames=("combine",), donate_argnums=(0, 1, 2, 3))
 def merge_into_accumulator(acc_hi, acc_lo, acc_vals, ovf, b_hi, b_lo, b_vals,
                            combine="sum"):
-    """Fold one mapped batch into the running accumulator.
-
-    Concatenate accumulator (capacity C) with batch (size B), reduce, keep the
-    first C rows.  ``ovf`` is a cumulative dropped-key counter carried through
-    every merge: keys truncated past C add to it, so a later clean merge can
-    never shadow an earlier loss and an *exactly full* accumulator is not an
-    error.  Buffers are donated so the accumulator updates in place in HBM.
-    """
-    cap = acc_hi.shape[0]
-    hi = jnp.concatenate([acc_hi, b_hi])
-    lo = jnp.concatenate([acc_lo, b_lo])
-    vals = jnp.concatenate([acc_vals, b_vals])
-    u_hi, u_lo, u_vals, n_unique = reduce_pairs(hi, lo, vals, combine)
-    ovf = ovf + jnp.maximum(n_unique - cap, 0)
-    return u_hi[:cap], u_lo[:cap], u_vals[:cap], n_unique, ovf
+    """Fold one mapped batch into the running accumulator (the jitted
+    three-plane form of :func:`_merge_impl`; see there for the overflow
+    contract).  Buffers are donated so the accumulator updates in place
+    in HBM."""
+    return _merge_impl(acc_hi, acc_lo, acc_vals, ovf,
+                       b_hi, b_lo, b_vals, combine=combine)

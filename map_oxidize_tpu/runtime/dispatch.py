@@ -1,0 +1,273 @@
+"""Dispatch-batch policy: how many logical chunks one device launch
+retires on the streamed paths.
+
+Round 5's decomposition and the dispatch-gap profiler agree that the
+streamed paths are *launch-bound*, not bandwidth-bound: each separately
+dispatched executable costs ~150-250 ms through the measured
+remote-attach tunnel regardless of payload.  The fix (DrJAX's
+flat-program-count argument, arXiv:2403.07128) is to keep the program
+count flat and amortize launches: ``lax.scan`` B chunks inside ONE
+program, so the per-launch floor is paid once per B chunks instead of
+once per chunk.
+
+This module owns the **B decision** so every call site (the streamed
+k-means driver, the fold engine, bench) resolves it the same way:
+
+* an explicit ``--dispatch-batch N`` wins verbatim (capped at the chunk
+  count — padding a block mostly with dead chunks would only waste
+  transfer and compile a needlessly large shape);
+* ``auto`` solves the overlap roofline from measured inputs.  With
+  double-buffered staging, steady-state wall per chunk is
+  ``max(produce_ms, floor_ms / B + compute_ms)`` — the host produce of
+  block i+1 hides behind block i's launch+compute.  The smallest B that
+  makes the device side sink under the host side is
+  ``ceil(floor / (produce - compute))``; when the host is not the
+  bottleneck (or produce is unknown) B amortizes the floor against
+  compute alone, ``ceil(floor / compute)``.  Inputs, in preference
+  order: the compile ledger's measured per-dispatch gap and sampled
+  device-compute (warm processes — the resident server's case), the
+  xprof roofline estimate (cost-analysis FLOPs over the session peak)
+  when cold, and platform defaults last;
+* the result is capped by the **HBM admission estimate**: two staged
+  blocks are in flight at once (double buffering), so B may not exceed
+  ``budget / (4 * chunk_bytes)`` against the probed device budget.
+
+Auto resolutions are memoized per (program, shape, platform) for the
+process lifetime: a warm server or a warm-then-timed bench run must not
+flip B between jobs (a flipped B is a fresh program variant — exactly
+the recompile the zero-delta gate exists to catch).
+
+The chosen B and every input that produced it are recorded as
+``dispatch/*`` gauges, so they ride ``JobResult.metrics``, the metrics
+document, and the run-ledger entry.  ``dispatch_batch`` is deliberately
+NOT ledger/checkpoint identity: outputs are bit-identical at any B, so
+runs gate and resume across B.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import sys
+import threading
+
+from map_oxidize_tpu.utils.logging import get_logger
+
+_log = get_logger(__name__)
+
+#: auto fallback when nothing is measurable (no warm stats, no peak)
+DEFAULT_AUTO_B = 4
+#: hard auto ceiling — past this the launch floor is <2% of block work
+#: even at the measured worst case, and block staging cost dominates
+MAX_AUTO_B = 64
+#: per-launch floor defaults when no measurement exists yet: the round-5
+#: tunnel measurement on TPU, and a token 1ms on hosts where dispatch is
+#: a local call (keeps auto ~= unbatched on CPU test meshes)
+TPU_FLOOR_MS = 150.0
+DEFAULT_FLOOR_MS = 1.0
+
+_auto_cache: dict = {}
+_auto_lock = threading.Lock()
+
+
+def _platform() -> str:
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return "unknown"
+    try:
+        return jax.devices()[0].platform
+    except Exception:
+        return "unknown"
+
+
+def hbm_budget_bytes() -> int:
+    """The admission-control HBM estimate: total reported device memory
+    across visible devices (the same probe the resident server's
+    admission controller uses).  0 = unknown (CPU, or jax not up)."""
+    try:
+        from map_oxidize_tpu.serve.admission import probe_hbm_budget
+
+        return probe_hbm_budget()
+    except Exception:
+        return 0
+
+
+def dispatch_floor_snapshot(program: str) -> tuple:
+    """``(dispatch_ms, steady_state_dispatches)`` of ``program`` as of
+    now — pass to :func:`measured_dispatch_floor_ms` as ``since`` to
+    scope the floor to one measurement window (the ledger is
+    process-global, so without a window two bench entries sharing a
+    program would contaminate each other's trajectory record)."""
+    from map_oxidize_tpu.obs.compile import LEDGER
+
+    p = LEDGER.programs.get(program)
+    if p is None:
+        return (0.0, 0)
+    return (p.dispatch_ms, p.dispatches - p.compiles)
+
+
+def measured_dispatch_floor_ms(program: str,
+                               since: tuple | None = None) -> float | None:
+    """Measured per-launch host overhead of ``program`` from the compile
+    ledger: mean dispatch gap (host handoff -> async return) over its
+    non-compiling dispatches — over the whole process lifetime, or past
+    a :func:`dispatch_floor_snapshot` when ``since`` is given.  This is
+    the ``dispatch_floor_ms`` record bench tracks per round.  None until
+    the program has steady-state dispatches (in the window)."""
+    from map_oxidize_tpu.obs.compile import LEDGER
+
+    p = LEDGER.programs.get(program)
+    if p is None:
+        return None
+    ms, n = p.dispatch_ms, p.dispatches - p.compiles
+    if since is not None:
+        ms -= since[0]
+        n -= since[1]
+    if n <= 0 or ms <= 0:
+        return None
+    return ms / n
+
+
+def measured_compute_ms_per_chunk(program: str) -> float | None:
+    """Measured device-compute per LOGICAL chunk of ``program`` from the
+    ledger's sampled ``block_until_ready`` waits, divided by the
+    program's observed chunks-per-dispatch (one dispatch may retire B
+    chunks)."""
+    from map_oxidize_tpu.obs.compile import LEDGER
+
+    p = LEDGER.programs.get(program)
+    if p is None or p.samples <= 0 or p.sampled_ms <= 0:
+        return None
+    per_dispatch = p.sampled_ms / p.samples
+    n = p.dispatches - p.compiles
+    cpd = (p.chunks / n) if (p.chunks and n > 0) else 1.0
+    return per_dispatch / max(cpd, 1.0)
+
+
+def has_cached_auto(program: str, chunk_device_bytes: int = 0,
+                    flops_per_chunk: float | None = None) -> bool:
+    """True when an auto resolution for this (program, shape, platform)
+    is already memoized — callers use this to skip the (real, paid)
+    produce probe whose result the cached resolution would ignore (a
+    warm resident server must not fault in a full chunk per job just to
+    feed a measurement the memo discards)."""
+    key = (program, chunk_device_bytes, flops_per_chunk, _platform())
+    with _auto_lock:
+        return key in _auto_cache
+
+
+def resolve_dispatch_batch(requested: int, *, n_chunks: int = 0,
+                           chunk_device_bytes: int = 0,
+                           flops_per_chunk: float | None = None,
+                           produce_ms: float | None = None,
+                           program: str = "kmeans/stream_step",
+                           default_auto: int = DEFAULT_AUTO_B,
+                           ) -> tuple[int, dict]:
+    """Resolve the effective dispatch batch B and the evidence behind it.
+
+    ``requested`` is the config value (0 = auto, N >= 1 pins).  Returns
+    ``(B, info)`` where ``info`` carries the mode and every auto input
+    (floor/produce/compute ms, their sources, the HBM cap) for the
+    ``dispatch/*`` metrics record.
+    """
+    if requested >= 1:
+        b = requested
+        info = {"mode": "fixed", "requested": requested}
+    else:
+        b, info = _resolve_auto(program, chunk_device_bytes,
+                                flops_per_chunk, produce_ms, default_auto)
+    if n_chunks > 0 and b > n_chunks:
+        b = n_chunks
+        info["capped_by_chunks"] = n_chunks
+    info["batch"] = max(b, 1)
+    return max(b, 1), info
+
+
+def _resolve_auto(program: str, chunk_device_bytes: int,
+                  flops_per_chunk: float | None,
+                  produce_ms: float | None, default_auto: int
+                  ) -> tuple[int, dict]:
+    key = (program, chunk_device_bytes, flops_per_chunk, _platform())
+    with _auto_lock:
+        hit = _auto_cache.get(key)
+    if hit is not None:
+        return hit[0], dict(hit[1])
+
+    info: dict = {"mode": "auto"}
+    env = os.environ.get("MOXT_DISPATCH_FLOOR_MS")
+    floor = None
+    if env:
+        try:
+            floor = float(env)
+            info["floor_source"] = "env"
+        except ValueError:
+            pass
+    if floor is None:
+        floor = measured_dispatch_floor_ms(program)
+        if floor is not None:
+            info["floor_source"] = "measured"
+    if floor is None:
+        floor = TPU_FLOOR_MS if _platform() == "tpu" else DEFAULT_FLOOR_MS
+        info["floor_source"] = "platform_default"
+    compute = measured_compute_ms_per_chunk(program)
+    if compute is not None:
+        info["compute_source"] = "measured"
+    elif flops_per_chunk:
+        from map_oxidize_tpu.obs.xprof import device_peaks
+
+        peak = device_peaks().get("flops")
+        if peak:
+            compute = flops_per_chunk / peak * 1e3
+            info["compute_source"] = "roofline_estimate"
+    info["floor_ms"] = round(floor, 4)
+    if compute is not None:
+        info["compute_ms_per_chunk"] = round(compute, 4)
+    if produce_ms is not None:
+        info["produce_ms_per_chunk"] = round(produce_ms, 4)
+
+    if compute is None and produce_ms is None:
+        b = default_auto
+        info["rule"] = "default_no_measurements"
+    else:
+        comp = compute or 0.0
+        headroom = (produce_ms - comp) if produce_ms is not None else None
+        if headroom is not None and headroom > 0.05:
+            # host-bound once overlapped: the smallest B whose launch
+            # floor sinks under the produce time
+            b = math.ceil(floor / headroom)
+            info["rule"] = "overlap_host_produce"
+        else:
+            b = math.ceil(floor / max(comp, 0.05))
+            info["rule"] = "amortize_vs_compute"
+    b = max(1, min(b, MAX_AUTO_B))
+
+    budget = hbm_budget_bytes()
+    if budget > 0 and chunk_device_bytes > 0:
+        # two staged blocks are in flight under double buffering, plus
+        # XLA's own working set: cap at a quarter of the budget per block
+        cap = max(1, int(budget // (4 * chunk_device_bytes)))
+        info["hbm_budget_bytes"] = budget
+        info["hbm_cap"] = cap
+        if b > cap:
+            b = cap
+            info["rule"] = info.get("rule", "") + "+hbm_capped"
+    with _auto_lock:
+        _auto_cache.setdefault(key, (b, dict(info)))
+        hit = _auto_cache[key]
+    return hit[0], dict(hit[1])
+
+
+def record_dispatch_batch(registry, b: int, info: dict,
+                          prefix: str = "dispatch") -> None:
+    """Export the decision as flat gauges (``dispatch/batch``,
+    ``dispatch/batch_mode``, ``dispatch/<input>`` ...) so it lands in
+    ``JobResult.metrics``, the metrics document, and the ledger entry —
+    the record the ISSUE's "auto resolving to a logged B" gate reads."""
+    if registry is None:
+        return
+    registry.set(f"{prefix}/batch", int(b))
+    registry.set(f"{prefix}/batch_mode", info.get("mode", "fixed"))
+    for k, v in info.items():
+        if k in ("mode", "batch") or v is None:
+            continue
+        registry.set(f"{prefix}/{k}", v)

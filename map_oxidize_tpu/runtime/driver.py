@@ -861,23 +861,36 @@ def _run_kmeans_body(config: JobConfig, obs: Obs,
 
             from map_oxidize_tpu.runtime.engine import pick_device
 
-            # dispatch amortization wants BIG chunks (~200ms per launch
-            # through the measured tunnel, RESULTS.md round 5): floor the
-            # per-chunk bytes at 256MB regardless of config.chunk_bytes.
-            # The divisor budgets the per-chunk DEVICE working set — the
-            # points block plus the (chunk, k) distance and one-hot
-            # intermediates — the same 4*(d + 2k) accounting as the fit
-            # heuristic, else a large-k job would OOM the chip with the
-            # very path meant to avoid that.  (Per CHUNK, not per shard:
-            # the budget is conservative for a multi-device mesh, where
-            # each shard sees chunk_rows/S of it.)
-            chunk_rows = max(1, max(config.chunk_bytes, 256 << 20)
+            # dispatch amortization used to want BIG chunks (~200ms per
+            # launch through the measured tunnel, RESULTS.md round 5;
+            # a hard 256MB floor overrode config.chunk_bytes here).
+            # Scan-batched dispatch moved that amortization to B — a
+            # launch retires B chunks, so config.chunk_bytes is honored
+            # verbatim and small chunks batch into full-size launches
+            # (finer staging granularity, same bytes per launch).
+            # Chunking deliberately does NOT depend on dispatch_batch:
+            # the per-logical-chunk comms identity (and with it the
+            # comms/*/bytes ledger gate) compares across B only because
+            # the chunk count is B-invariant.  The divisor budgets the
+            # per-chunk DEVICE working set — the points block plus the
+            # (chunk, k) distance and one-hot intermediates — the same
+            # 4*(d + 2k) accounting as the fit heuristic, else a
+            # large-k job would OOM the chip with the very path meant
+            # to avoid that.  (Per CHUNK, not per shard: the budget is
+            # conservative for a multi-device mesh, where each shard
+            # sees chunk_rows/S of it.)
+            chunk_rows = max(1, config.chunk_bytes
                              // (4 * (int(d) + 2 * config.kmeans_k)))
             timings: dict = {}
             kw = dict(iters=remaining, chunk_rows=chunk_rows,
                       precision=config.kmeans_precision, timings=timings,
                       on_iter=_iter_done if want_iter_cb else None,
-                      pipeline_depth=config.pipeline_depth, obs=obs)
+                      pipeline_depth=config.pipeline_depth, obs=obs,
+                      # B is deliberately NOT checkpoint identity (see
+                      # the meta above): outputs are bit-identical at
+                      # any B, so a snapshot written at one B resumes
+                      # under any other (tests/test_dispatch_batch.py)
+                      dispatch_batch=config.dispatch_batch)
             if n_shards > 1:
                 # streaming x sharding composed: each chunk's put splits
                 # across the mesh and the step is the shared one-psum
@@ -897,6 +910,8 @@ def _run_kmeans_body(config: JobConfig, obs: Obs,
                     metrics.set("pipeline/overlap_ratio", tv)
                 elif tk == "feed_wait_s":
                     metrics.count("pipeline/feed_wait_ms", tv * 1e3)
+                elif tk == "dispatch_batch":
+                    pass  # already recorded as the dispatch/* gauges
                 else:
                     metrics.set(f"time/{tk}", round(tv, 4))
         elif device_mode:

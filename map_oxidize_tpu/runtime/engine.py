@@ -49,6 +49,7 @@ from map_oxidize_tpu.ops.segment_reduce import (
     _identity,
     make_accumulator,
     merge_into_accumulator,
+    merge_packed_batch_into_accumulator,
     merge_packed_into_accumulator,
     pack_accumulator_state,
 )
@@ -351,6 +352,16 @@ class DeviceReduceEngine(StreamingEngineBase):
         self.feed_batch = config.batch_size
         self.max_capacity = config.key_capacity
         self.capacity = min(config.initial_key_capacity, self.max_capacity)
+        #: scan-batched dispatch on the packed merge path: full-size
+        #: packed feed batches queue host-side and ship as ONE stacked
+        #: ``(B, 3, feed_batch)`` transfer + ONE ``lax.scan`` launch
+        #: retiring B merges (the fold-engine half of the dispatch-floor
+        #: attack).  Only an EXPLICIT ``--dispatch-batch N>1`` batches
+        #: here — 0 (auto) targets the streamed k-means dispatch, whose
+        #: roofline inputs exist; the engine's feed cadence does not
+        #: measure cleanly at job start.
+        self.dispatch_batch = max(1, config.dispatch_batch)
+        self._pack_queue: list = []
         # eager jnp fill pinned to the engine's own device: materializes in
         # place (no host buffer shipped over the slow link) and never touches
         # the default accelerator, which may be absent/unhealthy when this is
@@ -369,7 +380,24 @@ class DeviceReduceEngine(StreamingEngineBase):
             ]
             self._ovf = jax.device_put(jnp.zeros((), jnp.int32), self.device)
 
+    def _round_batch(self, n: int) -> int:
+        # scan-batched dispatch wants every packable slice at the ONE
+        # queue shape (feed_batch): a short slice that pow2-rounds
+        # below it could not stack into the compiled (B, 3, feed_batch)
+        # block and would force-drain a partial queue padded with dead
+        # batches — and flush's common full+tail slicing would then
+        # ship up to B-1 dead transfers per flush, making B>1 strictly
+        # worse than B=1.  Rounding tails to full size lets them queue;
+        # the dead-batch pad is reserved for the rare forced drains
+        # (read/state/finalize/non-packed feeds).
+        if self.dispatch_batch > 1 and self._packable():
+            return self.feed_batch
+        return super()._round_batch(n)
+
     def _read_live(self) -> int:
+        # queued packed batches haven't merged yet: drain so the exact
+        # count (which REPLACES the host upper bound) reflects them
+        self._drain_packs()
         return int(self._n_unique)
 
     def _apply_grow(self, new_cap: int) -> None:
@@ -400,6 +428,20 @@ class DeviceReduceEngine(StreamingEngineBase):
             packed[2] = vals.view(np.uint32)
             incoming = self._incoming(hi.shape[0])
             self._ensure_capacity(incoming)
+            if (self.dispatch_batch > 1
+                    and hi.shape[0] == self.feed_batch):
+                # scan-batched path: queue full-size packed batches and
+                # ship B per launch (_round_batch pads every packable
+                # slice to feed_batch under batching, so this is the
+                # only packable case; a stale short slice would drain
+                # the queue first — merge ORDER is the feed order at
+                # any B — and take the single program).
+                self._pack_queue.append(packed)
+                self._n_live_ub += incoming
+                if len(self._pack_queue) >= self.dispatch_batch:
+                    self._drain_packs()
+                return
+            self._drain_packs()
             *self._acc, self._n_unique, self._ovf = (
                 merge_packed_into_accumulator(
                     *self._acc, self._ovf,
@@ -409,12 +451,48 @@ class DeviceReduceEngine(StreamingEngineBase):
             )
             self._n_live_ub += incoming
             return
+        self._drain_packs()
         batch = jax.device_put(padded, self.device)
         self.feed_device(*batch, count_rows=False)
+
+    def _drain_packs(self) -> None:
+        """Ship the queued packed batches as ONE stacked transfer + ONE
+        scan launch.  A partial queue pads to the full ``B`` with dead
+        batches (SENTINEL keys, identity values) so exactly one
+        ``(B, 3, feed_batch)`` shape ever compiles — a dead merge is a
+        bit-exact no-op on the accumulator, so outputs are identical to
+        B separate merges (tests/test_dispatch_batch.py pins this and
+        the zero-compile-delta sweep)."""
+        if not self._pack_queue:
+            return
+        b = self.dispatch_batch
+        real = len(self._pack_queue)
+        if len(self._pack_queue) < b:
+            dead = np.empty((3, self.feed_batch), np.uint32)
+            dead[0] = SENTINEL
+            dead[1] = SENTINEL
+            dead[2] = np.full(
+                self.feed_batch,
+                _identity(self.combine, np.int32)).view(np.uint32)
+            self._pack_queue.extend(
+                [dead] * (b - len(self._pack_queue)))
+        stacked = np.stack(self._pack_queue)  # fresh: safe to hand off
+        self._pack_queue = []
+        *self._acc, self._n_unique, self._ovf = (
+            merge_packed_batch_into_accumulator(
+                *self._acc, self._ovf,
+                jax.device_put(stacked, self.device),
+                combine=self.combine,
+                # per-chunk attribution counts the REAL merges, not the
+                # dead pad (consistent with the comms accounting)
+                observed_chunks=real,
+            )
+        )
 
     def feed_device(self, hi, lo, vals, count_rows: bool = True) -> None:
         """Merge a device-resident batch — the hand-off used by the on-device
         map path (no host staging, padding, or transfer)."""
+        self._drain_packs()  # keep merge order = feed order
         incoming = self._incoming(hi.shape[0])
         self._ensure_capacity(incoming)
         if count_rows:
@@ -428,6 +506,7 @@ class DeviceReduceEngine(StreamingEngineBase):
         """Host snapshot of the device reduce state (the device-map paths'
         checkpoint unit: map outputs never exist on the host there, so the
         resumable artifact is the reduced state itself)."""
+        self._drain_packs()
         return {
             "acc_hi": np.asarray(self._acc[0]),
             "acc_lo": np.asarray(self._acc[1]),
@@ -461,6 +540,7 @@ class DeviceReduceEngine(StreamingEngineBase):
             )
 
     def _finalize(self):
+        self._drain_packs()
         if self._n_unique is None:
             # no merge ever ran: the accumulator is pristine — answer from
             # the host without a device round trip

@@ -37,6 +37,14 @@ iterator untouched (the serial baseline path, zero new machinery), and
 with an :class:`~map_oxidize_tpu.obs.Obs` bundle it records the counters
 (``pipeline/produce_ms``, ``pipeline/feed_wait_ms``) and the
 ``pipeline/overlap_ratio`` gauge on exhaustion.
+
+:class:`BlockStager` is the prefetcher grown into a **batching,
+double-buffered device stager** (the dispatch-floor attack's host half):
+it groups the stream into ``batch``-chunk blocks and runs the caller's
+``stage_fn`` — pinned-buffer assembly + the async ``device_put`` — in
+the producer thread, so the transfer of block i+1 overlaps the device
+compute of block i while the scan-batched step retires B chunks per
+launch.
 """
 
 from __future__ import annotations
@@ -155,6 +163,57 @@ class ChunkPrefetcher:
                     self._q.get_nowait()
             except queue.Empty:
                 pass
+
+
+def chunk_groups(items: Iterable, batch: int) -> list:
+    """Group ``items`` into lists of at most ``batch`` (the last group
+    may be short) — the block layout both :func:`staged_blocks` and
+    :class:`BlockStager` consume."""
+    if batch < 1:
+        raise ValueError(f"dispatch batch must be >= 1, got {batch}")
+    items = list(items)
+    return [items[i:i + batch] for i in range(0, len(items), batch)]
+
+
+def staged_blocks(groups: Iterable, stage_fn):
+    """Serial staging generator (the ``depth<=1`` control arm of
+    :class:`BlockStager`, and its producer body): yields
+    ``stage_fn(group)`` for each pre-built group (see
+    :func:`chunk_groups`)."""
+    for group in groups:
+        yield stage_fn(group)
+
+
+class BlockStager(ChunkPrefetcher):
+    """Batching, double-buffered device stager — the host half of the
+    dispatch-floor attack.
+
+    Runs ``stage_fn(group)`` — assembly of one pre-grouped block (see
+    :func:`chunk_groups`) into a fresh staging buffer plus the async
+    ``device_put`` — in the producer thread, so staging AND transferring
+    block i+1 overlap the consumer's dispatch/compute of block i.  The
+    caller builds the group sequence, which may span ITERATIONS of a
+    multi-pass consumer (streamed k-means stages iteration i+1's first
+    block while iteration i's tail block still computes — data blocks do
+    not depend on the evolving carry, so the inter-iteration staging
+    bubble is free to close).  ``stage_fn`` must hand its buffer's
+    ownership to jax at the put (``utils.jax_compat.device_put_handoff``:
+    the CPU backend zero-copy-aliases large host buffers and an
+    accelerator's DMA read is async, so buffer REUSE corrupts in-flight
+    blocks — measured, see tests/test_dispatch_batch.py).  Memory stays
+    flat anyway: the depth-bounded queue backpressures the producer, so
+    at most ``depth+1`` staged blocks exist host-side while HBM holds
+    the executing block plus the prefetched ones — the double-buffer
+    contract at the default ``depth=1``.
+
+    ``produce_s`` here measures assembly+put per block — exactly the
+    "host-produce" input the auto dispatch-batch roofline consumes.
+    """
+
+    def __init__(self, groups: Iterable, stage_fn,
+                 depth: int = 1, name: str = "stager"):
+        super().__init__(staged_blocks(groups, stage_fn),
+                         depth, name=name)
 
 
 def pipelined(it: Iterable[T], depth: int, obs=None,

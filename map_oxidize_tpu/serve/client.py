@@ -119,7 +119,11 @@ def coerce_overrides(pairs: list[str]) -> dict:
         if not sep:
             raise ValueError(f"--set takes key=value, got {pair!r}")
         t = str(types.get(key, "str"))
-        if t.startswith("int"):
+        if key == "dispatch_batch" and raw == "auto":
+            # same spelling as the job CLI's --dispatch-batch {auto,N}:
+            # 'auto' is the 0 sentinel (measured auto-pick at job start)
+            out[key] = 0
+        elif t.startswith("int"):
             out[key] = int(raw, 0)
         elif t.startswith("float"):
             out[key] = float(raw)

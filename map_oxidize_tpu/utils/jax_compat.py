@@ -27,3 +27,22 @@ def shard_map(f, *, mesh, in_specs, out_specs, **kw):
     if "check_vma" in kw:
         kw["check_rep"] = kw.pop("check_vma")
     return legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+
+def device_put_handoff(x, sharding):
+    """``jax.device_put`` of a staging buffer whose OWNERSHIP passes to
+    jax: the caller must never mutate ``x`` after this call.
+
+    This is the only alias-safe staging contract that holds everywhere:
+    the CPU backend zero-copies large aligned numpy buffers — measured on
+    jax 0.4.37 it does so even under ``may_alias=False``, so a
+    reuse-the-buffer scheme corrupts in-flight device arrays no matter
+    what flags ride the put — and an accelerator ``device_put`` returns
+    before its background DMA finished reading the host buffer.  Handing
+    each staged block a fresh buffer makes the put zero-copy where the
+    backend allows it and race-free where it doesn't; host-memory
+    flatness comes from the stager's queue backpressure, not from slot
+    reuse."""
+    import jax
+
+    return jax.device_put(x, sharding)

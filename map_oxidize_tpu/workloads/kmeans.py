@@ -312,7 +312,8 @@ def kmeans_fit_streamed_device(path: str, centroids: np.ndarray,
                                iters: int = 1, chunk_rows: int = 1 << 21,
                                device=None, precision: str = "highest",
                                timings: dict | None = None, on_iter=None,
-                               pipeline_depth: int = 2, obs=None):
+                               pipeline_depth: int = 2, obs=None,
+                               dispatch_batch: int = 0):
     """Beyond-HBM k-means with DEVICE assignment: points stream through
     the chip in fixed-row chunks each iteration — SURVEY §7 hard part
     (c)'s double-buffered formulation, now the 1-device mesh case of
@@ -344,10 +345,13 @@ def kmeans_fit_streamed_device(path: str, centroids: np.ndarray,
     each separately launched executable costs ~150-250 ms through the
     remote-attach tunnel regardless of size (the round-3 fetch-cost note,
     runtime/collect.py, re-measured round 5), so one iteration is exactly
-    ``n_chunks`` dispatches — the accumulator init is folded into the
-    first chunk's step and the centroid update into the last chunk's
-    (static first/last flags), and the all-ones weight column for full
-    chunks is a cached device-resident constant, not a per-chunk put."""
+    ``ceil(n_chunks / B)`` dispatches — ``dispatch_batch`` (B) chunks
+    retire per launch via the scanned step (0 = auto-picked from the
+    measured floor/produce/compute roofline), the accumulator init is
+    folded into the first block's scan and the centroid update into the
+    last block's (static first/last flags), and the all-ones weight
+    stack for full blocks is a cached device-resident constant, not a
+    per-block put."""
     import jax
 
     from map_oxidize_tpu.parallel.kmeans import kmeans_fit_streamed
@@ -358,7 +362,8 @@ def kmeans_fit_streamed_device(path: str, centroids: np.ndarray,
                                chunk_rows=chunk_rows, device=device,
                                precision=precision, timings=timings,
                                on_iter=on_iter,
-                               pipeline_depth=pipeline_depth, obs=obs)
+                               pipeline_depth=pipeline_depth, obs=obs,
+                               dispatch_batch=dispatch_batch)
 
 
 def write_centroids(path: str, centroids: np.ndarray) -> None:

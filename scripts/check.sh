@@ -71,6 +71,78 @@ python -m map_oxidize_tpu obs diff --ledger-dir "$smoke/ledger"
 python -m map_oxidize_tpu obs diff --ledger-dir "$smoke/ledger" \
     --gate -- -1 -1
 
+echo "== dispatch-floor smoke =="
+# scan-batched streamed k-means: a center-seeded corpus streams through
+# the device in 5 chunks/iteration at --dispatch-batch 4 (one full block
+# + a zero-weight-padded tail = exactly the 2 first/last program
+# variants), twice so the ledger has a same-B previous entry; then an
+# --dispatch-batch auto run must record its resolved B in the ledger
+python - "$smoke" <<'EOF'
+import sys
+import numpy as np
+rng = np.random.default_rng(11)
+c = rng.normal(0, 10, (4, 8)).astype(np.float32)
+pts = (c[rng.integers(0, 4, 80_000)]
+       + rng.normal(0, 0.5, (80_000, 8))).astype(np.float32)
+pts[:4] = c  # center-seeded: assignment parity is well-conditioned
+np.save(f"{sys.argv[1]}/kpoints.npy", pts)
+EOF
+for _ in 1 2; do
+    JAX_PLATFORMS=cpu python -m map_oxidize_tpu kmeans \
+        "$smoke/kpoints.npy" --output "$smoke/kcentroids.npy" \
+        --kmeans-k 4 --kmeans-iters 2 --mapper auto --kmeans-fit-bytes 64 \
+        --chunk-mb 1 --num-shards 1 --dispatch-batch 4 --quiet \
+        --metrics-out "$smoke/kmetrics.json" \
+        --ledger-dir "$smoke/kledger" > /dev/null
+done
+JAX_PLATFORMS=cpu python -m map_oxidize_tpu kmeans \
+    "$smoke/kpoints.npy" --output "$smoke/kcentroids_auto.npy" \
+    --kmeans-k 4 --kmeans-iters 2 --mapper auto --kmeans-fit-bytes 64 \
+    --chunk-mb 1 --num-shards 1 --dispatch-batch auto --quiet \
+    --metrics-out "$smoke/kmetrics_auto.json" \
+    --ledger-dir "$smoke/kledger" > /dev/null
+python - "$smoke" <<'EOF'
+import json, sys
+import numpy as np
+d = sys.argv[1]
+m = json.load(open(f"{d}/kmetrics.json"))
+row = m["xprof"]["programs"]["kmeans/stream_step"]
+# exact compile counts: B=4 over 5 chunks/iter is 2 blocks -> exactly
+# the (first) and (padded-tail last) variants, nothing else
+assert row["compiles"] == 2, f"expected exactly 2 compiles, got {row}"
+# per-chunk attribution counts REAL chunks (the padded tail's dead
+# chunks are excluded, same as the comms accounting): the warm
+# iteration's 2 dispatches retire 4 + 1 real chunks -> 2.5
+assert row["chunks_per_dispatch"] == 2.5, row
+assert row["dispatch_gap_per_chunk_ms"] is not None
+assert m["gauges"]["dispatch/batch"] == 4
+# oracle parity: the scan-batched stream vs plain NumPy k-means
+pts = np.load(f"{d}/kpoints.npy")
+want = pts[:4].copy()
+for _ in range(2):
+    dist = ((pts[:, None, :] - want[None, :, :]) ** 2).sum(-1)
+    cid = dist.argmin(1)
+    for j in range(4):
+        sel = pts[cid == j]
+        if sel.shape[0]:
+            want[j] = sel.mean(0)
+got = np.load(f"{d}/kcentroids.npy")
+np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+np.testing.assert_allclose(np.load(f"{d}/kcentroids_auto.npy"), want,
+                           rtol=1e-3, atol=1e-3)
+led = [json.loads(l) for l in open(f"{d}/kledger/ledger.jsonl")]
+assert len(led) == 3
+# same-B fresh processes must land identical compile counts (the
+# cross-run form of the zero-recompile gate)
+k = "compile/kmeans/stream_step/compiles"
+assert led[0]["metrics"][k] == led[1]["metrics"][k] == 2, led[0]["metrics"]
+# the auto run's ledger entry records the B it resolved (and why)
+assert led[2]["metrics"]["dispatch/batch_mode"] == "auto", led[2]["metrics"]
+assert led[2]["metrics"]["dispatch/batch"] >= 1
+print("dispatch-floor OK: 2 exact compiles at B=4, oracle parity, "
+      f"auto resolved to B={led[2]['metrics']['dispatch/batch']}")
+EOF
+
 echo "== live telemetry smoke =="
 # a big-enough HIGH-CARDINALITY corpus (the native mapper pre-combines
 # per chunk, so a repeated-words corpus stages too few rows to flush

@@ -1,0 +1,465 @@
+"""Scan-batched multi-chunk dispatch (ISSUE 8): B logical chunks per
+device launch on every streamed path.
+
+The contract under test, at every B: outputs are BIT-IDENTICAL to the
+unbatched schedule (the scan carries the accumulator left-fold, padded
+tail chunks are zero-weight-masked), compile counts stay flat once the
+known (B, first, last) variants are warm, comms accounting totals are
+B-invariant (the ledger gate must compare identically across B), and
+checkpoint/resume works across any (B_write, B_resume) pair because B is
+deliberately NOT checkpoint identity.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from map_oxidize_tpu.api import MapOutput, SumReducer
+from map_oxidize_tpu.config import JobConfig
+from map_oxidize_tpu.ops.hashing import SENTINEL, HashDictionary, join_u64
+from map_oxidize_tpu.runtime import run_job
+from map_oxidize_tpu.runtime.dispatch import (
+    DEFAULT_AUTO_B,
+    record_dispatch_batch,
+    resolve_dispatch_batch,
+)
+from map_oxidize_tpu.runtime.engine import DeviceReduceEngine
+from map_oxidize_tpu.workloads.kmeans import kmeans_model
+
+
+def _blobs(rng, n=4000, d=8, k=5):
+    centers = rng.normal(0, 10, size=(k, d)).astype(np.float32)
+    pts = (centers[rng.integers(0, k, size=n)]
+           + rng.normal(0, 0.5, size=(n, d))).astype(np.float32)
+    return pts, centers
+
+
+# --- streamed k-means: scan-batched step parity ---------------------------
+
+
+@pytest.mark.parametrize("num_shards", [1, 8])
+def test_stream_kmeans_parity_across_B(tmp_path, rng, num_shards):
+    """Oracle-exact at B in {1, 2, 7} (7 does not divide the chunk count,
+    so the tail block pads with zero-weight dead chunks), on a 1-device
+    mesh and the 8-virtual-device CPU mesh — and bit-identical across B
+    (the scan preserves the per-chunk left-fold accumulation order)."""
+    from map_oxidize_tpu.parallel.kmeans import kmeans_fit_streamed
+
+    pts, centers = _blobs(rng, n=5003, d=8, k=5)
+    pts[:5] = centers
+    path = tmp_path / "p.npy"
+    np.save(path, pts)
+    init = pts[:5].copy()
+    want = init
+    for _ in range(3):
+        want = kmeans_model(pts, want)
+
+    outs = {}
+    for b in (1, 2, 7):
+        outs[b] = kmeans_fit_streamed(str(path), init, iters=3,
+                                      chunk_rows=1000,
+                                      num_shards=num_shards, backend="cpu",
+                                      dispatch_batch=b)
+        np.testing.assert_allclose(outs[b], want, rtol=1e-3, atol=1e-3)
+    for b in (2, 7):
+        assert outs[b].tobytes() == outs[1].tobytes(), (
+            f"B={b} must be bit-identical to the unbatched schedule")
+
+
+def test_stream_kmeans_zero_compile_delta_sweeping_B(tmp_path, rng):
+    """After one warm pass per B, re-sweeping every B must add ZERO
+    compiles of kmeans/stream_step: each (B, first, last) variant is a
+    known program, and the padded tail block reuses the mid-stream shape
+    (the DrJAX flat-program-count invariant the ledger gate enforces)."""
+    from map_oxidize_tpu.obs.compile import LEDGER
+    from map_oxidize_tpu.parallel.kmeans import kmeans_fit_streamed
+
+    pts, centers = _blobs(rng, n=3000, d=6, k=4)
+    pts[:4] = centers
+    path = tmp_path / "p.npy"
+    np.save(path, pts)
+    init = pts[:4].copy()
+
+    def sweep():
+        for b in (1, 2, 7):
+            kmeans_fit_streamed(str(path), init, iters=2, chunk_rows=600,
+                                num_shards=8, backend="cpu",
+                                dispatch_batch=b)
+
+    sweep()  # warm: compiles the (B, first, last) variants once
+    before = LEDGER.programs["kmeans/stream_step"].compiles
+    sweep()
+    after = LEDGER.programs["kmeans/stream_step"].compiles
+    assert after == before, (
+        f"re-sweeping warm B values recompiled kmeans/stream_step "
+        f"({before} -> {after})")
+
+
+# --- comms accounting: B-invariant totals ---------------------------------
+
+
+def _stream_cfg(inp, b, **kw):
+    return JobConfig(input_path=str(inp), output_path="", backend="cpu",
+                     num_shards=8, mapper="auto", metrics=True,
+                     kmeans_k=3, kmeans_iters=2,
+                     kmeans_device_fit_bytes=64,  # force stream_device
+                     chunk_bytes=256 * 4 * (6 + 2 * 3),  # ~256-row chunks
+                     dispatch_batch=b, **kw)
+
+
+def test_comms_bytes_invariant_across_B(tmp_path, rng):
+    """The one (k, d+1) psum per LOGICAL chunk is recorded per real chunk
+    (padded dead chunks excluded), so comms/*/bytes and /calls totals —
+    the accounting identity the ledger gate compares — are identical at
+    any B."""
+    pts, centers = _blobs(rng, n=1000, d=6, k=3)
+    pts[:3] = centers
+    inp = tmp_path / "p.npy"
+    np.save(inp, pts)
+
+    got = {}
+    for b in (1, 4):
+        m = run_job(_stream_cfg(inp, b), "kmeans").metrics
+        assert m["dispatch/batch"] == b
+        got[b] = {k: v for k, v in m.items() if k.startswith("comms/")}
+    key = "comms/psum/kmeans/stream_step/bytes"
+    assert got[1][key] > 0
+    assert got[1] == got[4], (
+        "comms accounting must be invariant across dispatch batch")
+
+
+def test_comms_gate_catches_per_dispatch_accounting(tmp_path, rng):
+    """Injected regression: if the psum were recorded per DISPATCH
+    instead of per logical chunk, a B=4 run would book ~1/4 the bytes —
+    and the ledger gate comparing it against the correct entry must flag
+    unexplained comms growth in the B-dependent direction."""
+    from map_oxidize_tpu.obs.ledger import diff_entries
+
+    pts, centers = _blobs(rng, n=1000, d=6, k=3)
+    pts[:3] = centers
+    inp = tmp_path / "p.npy"
+    np.save(inp, pts)
+    m = run_job(_stream_cfg(inp, 1), "kmeans").metrics
+    key = "comms/psum/kmeans/stream_step/bytes"
+
+    def entry(metrics):
+        return {"version": "v", "workload": "kmeans", "config_hash": "h",
+                "phases_s": {}, "metrics": metrics}
+
+    correct = {key: m[key]}
+    buggy_b4 = {key: m[key] / 4}  # per-dispatch accounting at B=4
+    d = diff_entries(entry(buggy_b4), entry(correct))
+    assert any(key in r for r in d["regressions"]), (
+        "the comms gate must flag B-dependent accounting drift")
+    # and the CORRECT accounting diffs clean against itself across B
+    d = diff_entries(entry(correct), entry(correct))
+    assert not d["regressions"]
+
+
+# --- checkpoint identity: B is not part of it ------------------------------
+
+
+@pytest.mark.parametrize("b_write,b_resume", [(1, 4), (4, 1)])
+def test_checkpoint_resume_parity_across_B(tmp_path, rng, b_write,
+                                           b_resume):
+    """A streamed snapshot written at one B resumes under any other and
+    lands bit-identical to an uninterrupted run: B is stamped OUT of
+    checkpoint identity because outputs are B-invariant."""
+    pts, centers = _blobs(rng, n=1000, d=6, k=3)
+    pts[:3] = centers
+    inp = tmp_path / "p.npy"
+    np.save(inp, pts)
+
+    want = run_job(
+        dataclasses.replace(_stream_cfg(inp, 1), kmeans_iters=4),
+        "kmeans").centroids
+
+    ck = str(tmp_path / "ck")
+    run_job(dataclasses.replace(_stream_cfg(inp, b_write),
+                                checkpoint_dir=ck,
+                                keep_intermediates=True), "kmeans")
+    resumed = run_job(
+        dataclasses.replace(_stream_cfg(inp, b_resume), kmeans_iters=4,
+                            checkpoint_dir=ck), "kmeans")
+    assert resumed.metrics.get("resumed_iters") == 2, (
+        "a B mismatch must not invalidate the snapshot")
+    assert resumed.centroids.tobytes() == want.tobytes()
+
+
+# --- fold engine: scan-batched packed merges -------------------------------
+
+
+def _out(keys, vals=None):
+    keys = np.asarray(keys, np.uint64)
+    if vals is None:
+        vals = np.ones(len(keys), np.int32)
+    hi = (keys >> np.uint64(32)).astype(np.uint32)
+    lo = (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    return MapOutput(hi=hi, lo=lo, values=np.asarray(vals, np.int32),
+                     dictionary=HashDictionary())
+
+
+def _live(engine):
+    hi, lo, vals, n = engine.finalize()
+    hi, lo, vals = np.asarray(hi), np.asarray(lo), np.asarray(vals)
+    m = ~((hi == np.uint32(SENTINEL)) & (lo == np.uint32(SENTINEL)))
+    return dict(zip(join_u64(hi[m], lo[m]).tolist(), vals[m].tolist())), n
+
+
+def _feed_all(engine, rng):
+    """4 full feed-batch-sized slices plus a short tail (padded to full
+    size under batching, so it queues too), overlapping keys, varied
+    values.  Returns the oracle dict."""
+    oracle: dict = {}
+    for i in range(4):
+        keys = rng.integers(0, 900, size=512).astype(np.uint64)
+        vals = rng.integers(1, 100, size=512).astype(np.int32)
+        for kk, vv in zip(keys.tolist(), vals.tolist()):
+            oracle[kk] = oracle.get(kk, 0) + vv
+        engine.feed(_out(keys, vals))
+    keys = rng.integers(0, 900, size=77).astype(np.uint64)
+    for kk in keys.tolist():
+        oracle[kk] = oracle.get(kk, 0) + 1
+    engine.feed(_out(keys))
+    return oracle
+
+
+@pytest.mark.parametrize("b", [1, 2, 7])
+def test_engine_packed_batch_parity(b):
+    """DeviceReduceEngine at dispatch_batch B: packable slices (short
+    ones padded to full feed-batch size) queue and ship B per scanned
+    launch, a partial queue pads with dead SENTINEL batches at forced
+    drains — and the result equals the host oracle exactly at every B
+    (7 never divides the 5 queued slices, so the finalize drain pads)."""
+    rng = np.random.default_rng(5)
+    cfg = JobConfig(backend="cpu", batch_size=512, key_capacity=2048,
+                    initial_key_capacity=2048, dispatch_batch=b)
+    eng = DeviceReduceEngine(cfg, SumReducer())
+    oracle = _feed_all(eng, rng)
+    got, n = _live(eng)
+    assert n == len(oracle)
+    assert got == oracle
+
+
+def test_engine_tail_slices_queue_instead_of_draining():
+    """The common flush shape is full slices plus a short tail; a tail
+    that force-drained would pad the partial queue with up to B-1 dead
+    batches per flush, shipping MORE transfer at B>1 than at B=1 — the
+    opposite of the feature.  Under batching, short packable slices pad
+    to full feed-batch size and queue; the single-batch program never
+    runs, and dead padding happens only at the one finalize drain."""
+    from map_oxidize_tpu.obs.compile import LEDGER
+
+    rng = np.random.default_rng(5)
+    cfg = JobConfig(backend="cpu", batch_size=512, key_capacity=4096,
+                    initial_key_capacity=4096, dispatch_batch=4)
+    eng = DeviceReduceEngine(cfg, SumReducer())
+    single_before = (LEDGER.programs["engine/merge_packed"].dispatches
+                     if "engine/merge_packed" in LEDGER.programs else 0)
+    _feed_all(eng, rng)  # 4 full slices (one drained launch) + staged tail
+    assert not eng._pack_queue and eng._staged == 77
+    eng.flush()  # the short tail pads to full size and QUEUES
+    assert len(eng._pack_queue) == 1, (
+        "a tail slice must join the queue, not force-drain it")
+    p = LEDGER.programs["engine/merge_packed_batch"]
+    before = (p.dispatches, p.chunks)
+    eng.finalize()
+    assert p.dispatches - before[0] == 1, "finalize drains the queue once"
+    # per-merge attribution counts the 1 REAL queued slice, not the 3
+    # dead pads (the (4, 3, 512) shape compiled at the mid-feed drain,
+    # so this dispatch is warm and lands in the chunks accounting)
+    assert p.chunks - before[1] == 1
+    single_after = (LEDGER.programs["engine/merge_packed"].dispatches
+                    if "engine/merge_packed" in LEDGER.programs else 0)
+    assert single_after == single_before, (
+        "no slice fell back to the single-batch program")
+
+
+def test_engine_state_dict_drains_queue():
+    """export_state (the device-map checkpoint unit) must reflect queued
+    packed batches: the drain pads the partial queue and merges before
+    snapshotting."""
+    cfg = JobConfig(backend="cpu", batch_size=512, key_capacity=2048,
+                    initial_key_capacity=2048, dispatch_batch=4)
+    eng = DeviceReduceEngine(cfg, SumReducer())
+    eng.feed(_out(np.arange(512)))  # 1 of 4: sits in the queue
+    state = eng.export_state()
+    assert int(state["n_unique"]) == 512
+    hi, lo = state["acc_hi"], state["acc_lo"]
+    live = int(np.sum(~((hi == np.uint32(SENTINEL))
+                        & (lo == np.uint32(SENTINEL)))))
+    assert live == 512
+
+
+def test_engine_zero_compile_delta_sweeping_B():
+    """Re-sweeping warm engine B values must not recompile the batched
+    merge: one (B, 3, feed_batch) shape per B, dead-batch padding keeps
+    the tail on it."""
+    from map_oxidize_tpu.obs.compile import LEDGER
+
+    def sweep():
+        for b in (1, 2, 7):
+            rng = np.random.default_rng(5)
+            cfg = JobConfig(backend="cpu", batch_size=512,
+                            key_capacity=2048, initial_key_capacity=2048,
+                            dispatch_batch=b)
+            eng = DeviceReduceEngine(cfg, SumReducer())
+            _feed_all(eng, rng)
+            eng.finalize()
+
+    sweep()
+    progs = ("engine/merge_packed", "engine/merge_packed_batch")
+    before = {p: LEDGER.programs[p].compiles for p in progs
+              if p in LEDGER.programs}
+    sweep()
+    after = {p: LEDGER.programs[p].compiles for p in progs
+             if p in LEDGER.programs}
+    assert after == before
+
+
+# --- the B decision + its evidence -----------------------------------------
+
+
+def test_resolve_fixed_and_chunk_cap():
+    b, info = resolve_dispatch_batch(5, n_chunks=100)
+    assert b == 5 and info["mode"] == "fixed"
+    b, info = resolve_dispatch_batch(16, n_chunks=3)
+    assert b == 3 and info["capped_by_chunks"] == 3
+
+
+def test_resolve_auto_records_inputs(monkeypatch):
+    """auto with no measurements lands on the default and says so; the
+    HBM admission estimate caps the block; the decision is memoized per
+    (program, shape, platform) so a warm process can never flip B."""
+    import map_oxidize_tpu.runtime.dispatch as dsp
+
+    monkeypatch.setattr(dsp, "hbm_budget_bytes", lambda: 0)
+    b, info = resolve_dispatch_batch(0, n_chunks=1000,
+                                     program="test/no_measurements")
+    assert b == DEFAULT_AUTO_B
+    assert info["mode"] == "auto"
+    assert info["rule"] == "default_no_measurements"
+    assert info["floor_ms"] > 0
+
+    monkeypatch.setattr(dsp, "hbm_budget_bytes", lambda: 1 << 20)
+    b, info = resolve_dispatch_batch(0, n_chunks=1000,
+                                     chunk_device_bytes=1 << 18,
+                                     program="test/hbm_capped")
+    assert b == 1 and info["hbm_cap"] == 1  # budget / (4 * chunk_bytes)
+
+    b2, _ = resolve_dispatch_batch(0, n_chunks=1000,
+                                   chunk_device_bytes=1 << 18,
+                                   program="test/hbm_capped")
+    assert b2 == b, "auto resolution must be memoized (stable warm B)"
+    # callers read the memo state to skip the paid produce probe whose
+    # result a cached resolution would discard (warm-server economy)
+    from map_oxidize_tpu.runtime.dispatch import has_cached_auto
+
+    assert has_cached_auto("test/hbm_capped", 1 << 18)
+    assert not has_cached_auto("test/never_resolved", 1 << 18)
+
+
+def test_measured_floor_snapshot_window():
+    """dispatch_floor_snapshot scopes the floor to one measurement
+    window: the ledger is process-global, so two bench entries sharing
+    a program would otherwise contaminate each other's trajectory
+    record."""
+    from map_oxidize_tpu.obs.compile import LEDGER
+    from map_oxidize_tpu.runtime.dispatch import (
+        dispatch_floor_snapshot,
+        measured_dispatch_floor_ms,
+    )
+
+    name = "test/floor_window"
+    stats = LEDGER._stats(name)
+    LEDGER.record_dispatch(stats, 100.0, None, compiled=False)
+    snap = dispatch_floor_snapshot(name)
+    LEDGER.record_dispatch(stats, 2.0, None, compiled=False)
+    LEDGER.record_dispatch(stats, 4.0, None, compiled=False)
+    assert measured_dispatch_floor_ms(name, since=snap) == 3.0
+    assert measured_dispatch_floor_ms(name) == pytest.approx(106.0 / 3)
+    # an empty window (no steady-state dispatches since) is None
+    assert measured_dispatch_floor_ms(
+        name, since=dispatch_floor_snapshot(name)) is None
+
+
+def test_record_dispatch_batch_gauges():
+    from map_oxidize_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    record_dispatch_batch(reg, 4, {"mode": "auto", "batch": 4,
+                                   "floor_ms": 3.7, "rule": "x"})
+    s = reg.summary()
+    assert s["dispatch/batch"] == 4
+    assert s["dispatch/batch_mode"] == "auto"
+    assert s["dispatch/floor_ms"] == 3.7
+
+
+def test_dispatch_gauges_ride_job_metrics(tmp_path, rng):
+    """The chosen B and its evidence land in JobResult.metrics (and so
+    the metrics doc and run-ledger entry): the 'auto resolving to a
+    logged B' record the check.sh smoke reads."""
+    pts, centers = _blobs(rng, n=600, d=6, k=3)
+    pts[:3] = centers
+    inp = tmp_path / "p.npy"
+    np.save(inp, pts)
+    m = run_job(_stream_cfg(inp, 0), "kmeans").metrics
+    assert m["dispatch/batch_mode"] == "auto"
+    assert m["dispatch/batch"] >= 1
+    assert "dispatch/rule" in m or "dispatch/floor_ms" in m
+
+
+# --- per-logical-chunk dispatch attribution --------------------------------
+
+
+def test_observed_jit_chunk_attribution():
+    """A scan-batched program declares chunks_of: non-compiling
+    dispatches accumulate logical chunks next to the dispatch wall, so
+    per-chunk gap (the dispatch-floor trajectory number) divides out B."""
+    import jax
+    import jax.numpy as jnp
+
+    from map_oxidize_tpu.obs.compile import LEDGER, observed_jit
+
+    name = "test/chunked_prog"
+    fn = observed_jit(name, jax.jit(lambda x: jnp.sum(x, axis=1)),
+                      chunks_of=lambda *a, **kw: a[0].shape[0])
+    x = np.ones((4, 8), np.float32)
+    fn(x)  # compiling call: excluded from the steady-state populations
+    fn(x)
+    fn(x)
+    p = LEDGER.programs[name]
+    assert p.chunks == 8  # 2 non-compiling dispatches x 4 chunks
+
+
+# --- CLI / serve spelling ---------------------------------------------------
+
+
+def test_cli_dispatch_batch_arg():
+    import argparse
+
+    from map_oxidize_tpu.cli import _dispatch_batch_arg, build_parser
+
+    assert _dispatch_batch_arg("auto") == 0
+    assert _dispatch_batch_arg("8") == 8
+    for bad in ("0", "-2", "many"):
+        with pytest.raises(argparse.ArgumentTypeError):
+            _dispatch_batch_arg(bad)
+    args = build_parser().parse_args(
+        ["wordcount", "in", "--dispatch-batch", "auto"])
+    assert args.dispatch_batch == 0
+
+
+def test_serve_override_accepts_auto():
+    from map_oxidize_tpu.serve.client import coerce_overrides
+
+    assert coerce_overrides(["dispatch_batch=auto"]) == {"dispatch_batch": 0}
+    assert coerce_overrides(["dispatch_batch=4"]) == {"dispatch_batch": 4}
+
+
+def test_config_validates_dispatch_batch():
+    with pytest.raises(ValueError):
+        JobConfig(input_path="x", dispatch_batch=-1).validate()
+    with pytest.raises(ValueError):
+        JobConfig(input_path="x", dispatch_batch=4096).validate()
