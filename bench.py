@@ -378,6 +378,17 @@ def main(argv=None) -> int:
         })
         headline = (rate, words, rate / base_rate)
 
+    # SLO plane summary: any alert fired during a benchmarked run rides
+    # the artifact (and fails --gate below — a clean bench must be
+    # alert-silent; the rules already encode the tolerance)
+    alert_summary: dict = {"fired": 0, "by_workload": {}}
+    for _name, _e in workloads.items():
+        if isinstance(_e, dict):
+            _f = (_e.get("metrics_snapshot") or {}).get("alerts/fired")
+            if isinstance(_f, (int, float)) and _f > 0:
+                alert_summary["fired"] += _f
+                alert_summary["by_workload"][_name] = _f
+
     detail_path = os.path.join(CACHE_DIR, "BENCH_DETAIL.json")
     with open(detail_path, "w") as f:
         json.dump({
@@ -391,6 +402,7 @@ def main(argv=None) -> int:
                                "vs up-front baseline",
             "cpu_baseline_words_per_sec": round(base_rate, 1),
             "session_probes": probes,
+            "alert_summary": alert_summary,
             "per_size": per_size,
             "workloads": workloads,
         }, f, indent=1)
@@ -409,6 +421,14 @@ def main(argv=None) -> int:
                     f"{entry['workload']}: {r}"
                     for r in _ledger.gate_against_previous(
                         args.ledger_dir, entry, args.gate_tolerance_pct)]
+                # the SLO plane's absolute gate: ANY alert firing on a
+                # clean benchmarked run fails, prior entry or not (the
+                # cross-run alerts/fired diff only catches increases)
+                fired = entry["metrics"].get("alerts/fired")
+                if isinstance(fired, (int, float)) and fired > 0:
+                    gate_failures.append(
+                        f"{entry['workload']}: {fired:g} SLO alert(s) "
+                        "fired during the benchmarked run")
             _ledger.append(args.ledger_dir, entry)
 
     # compact scoreboard line: one ratio per workload, full detail on disk.
@@ -475,7 +495,7 @@ def _bench_ledger_entries(headline, workloads) -> list:
         metrics.update({k: v for k, v in e.get("metrics_snapshot",
                                                {}).items()
                         if k.startswith(("compile/", "xprof/", "comms/",
-                                         "heartbeat/"))})
+                                         "heartbeat/", "alerts/"))})
         entry = dict(base, workload=f"bench/{name}", metrics=metrics)
         if "ab_pairs" in e:
             # these entries switched measurement method (best-of ->
@@ -587,7 +607,7 @@ def _metrics_snapshot(result) -> dict:
                              "shuffle/", "engine/", "mem/", "pipeline/",
                              "feed_block_ms/", "compile/", "xprof/",
                              "device/", "hbm/", "comms/", "heartbeat/",
-                             "dispatch/"))}
+                             "dispatch/", "alerts/"))}
     return snap
 
 

@@ -197,6 +197,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "histogram quantile (metrics doc `series` "
                         "section + /series endpoint); 0 = off unless "
                         "--obs-port is set (then 1s)")
+    p.add_argument("--slo-rules", default=None,
+                   help="SLO/alerting rule set for the live plane: a "
+                        "JSON file path or inline JSON (a list extends "
+                        "the built-in defaults; {\"defaults\": false, "
+                        "\"rules\": [...]} replaces them).  Evaluated "
+                        "whenever the time-series recorder runs; firing "
+                        "rules emit [alert] lines, serve at /alerts, "
+                        "and write incident bundles")
+    p.add_argument("--incident-dir", default=None,
+                   help="where SLO incident bundles land (series window "
+                        "+ status snapshot per alert firing); default: "
+                        "the --crash-dir, if any")
     p.add_argument("--keep-intermediates", action="store_true")
     p.add_argument("-v", "--verbose", action="store_true")
     p.add_argument("-q", "--quiet", action="store_true")
@@ -239,6 +251,8 @@ def config_from_args(args: argparse.Namespace) -> JobConfig:
         stall_warn_factor=args.stall_factor,
         obs_port=args.obs_port,
         obs_sample_s=args.obs_sample_interval,
+        slo_rules=args.slo_rules,
+        incident_dir=args.incident_dir,
         rescan_full=args.rescan_full,
         collect_max_rows=args.collect_max_rows,
         hll_precision=args.hll_precision,

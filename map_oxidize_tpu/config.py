@@ -145,6 +145,17 @@ class JobConfig:
     #: metrics doc's ``series`` section + the live /series endpoint).
     #: 0 = off, unless --obs-port is set (serving implies sampling, 1s)
     obs_sample_s: float = 0.0
+    #: SLO/alerting plane (obs/slo.py): rule set for the alert evaluator
+    #: that watches the time-series ring whenever it runs.  None = the
+    #: built-in defaults; else a JSON file path or inline JSON — a list
+    #: EXTENDS the defaults, {"defaults": false, "rules": [...]}
+    #: replaces them.  Firing/resolved transitions emit [alert]
+    #: heartbeat lines, serve at /alerts, count into alerts/* (ledger-
+    #: gated), and write incident bundles
+    slo_rules: str | None = None
+    #: where alert incident bundles land (series window + /status
+    #: snapshot per firing); None = the --crash-dir, if any
+    incident_dir: str | None = None
     #: multi-host: coordination-service address ("host:port"); empty = the
     #: single-process path.  With it set, dist_num_processes and
     #: dist_process_id select this process's slot; jax.distributed is
@@ -244,6 +255,13 @@ class JobConfig:
                 "use a lower port or 0 (ephemeral)")
         if self.obs_sample_s < 0:
             raise ValueError("obs_sample_s must be >= 0 (0 = off)")
+        if self.slo_rules:
+            from map_oxidize_tpu.obs.slo import load_rules
+
+            try:
+                load_rules(self.slo_rules)
+            except (OSError, ValueError) as e:
+                raise ValueError(f"invalid slo_rules: {e}") from e
         from map_oxidize_tpu.workloads.distinct import HLL_P_MIN, HLL_P_MAX
 
         if not HLL_P_MIN <= self.hll_precision <= HLL_P_MAX:
@@ -303,6 +321,12 @@ class ServeConfig:
     #: server-level telemetry cadence (the time-series ring + HBM
     #: sampler on the server's own obs bundle)
     obs_sample_s: float = 1.0
+    #: SLO rule set for the SERVER's alert evaluator (serve-scoped
+    #: rules see the server-lifetime registry: queue-wait p95, warm
+    #: recompiles, HBM watermark); same spelling as JobConfig.slo_rules
+    #: ("" = built-in defaults).  Per-job rules ride job submissions as
+    #: a config override instead
+    slo_rules: str = ""
     #: per-job silent-heartbeat/series cadence (gives every job's /jobs
     #: row live rows/sec without --progress); 0 disables
     job_sample_s: float = 0.5
@@ -328,6 +352,13 @@ class ServeConfig:
         if self.max_history < 1:
             raise ValueError("max_history must be >= 1 (a finished job "
                              "must stay visible to its waiting client)")
+        if self.slo_rules:
+            from map_oxidize_tpu.obs.slo import load_rules
+
+            try:
+                load_rules(self.slo_rules)
+            except (OSError, ValueError) as e:
+                raise ValueError(f"invalid slo_rules: {e}") from e
         if not self.spool_dir:
             raise ValueError("spool_dir must be set")
         return self

@@ -53,6 +53,17 @@ The live telemetry plane on top of all of it (ISSUE-6):
 * :mod:`~map_oxidize_tpu.obs.context` — per-job routing so concurrent
   jobs in one process keep disjoint obs state.
 
+The watcher on top of the live plane (ISSUE-9):
+
+* :mod:`~map_oxidize_tpu.obs.slo` — declarative SLO rules evaluated
+  continuously against the series ring (``--slo-rules``): firing/
+  resolved state machines, ``[alert]`` heartbeat lines, the ``/alerts``
+  endpoint, incident bundles, and ``alerts/*`` gate counters;
+* :mod:`~map_oxidize_tpu.obs.trend` — cross-run regression forensics
+  over the ledger history and BENCH rounds (``obs trend``): per-series
+  trajectories, step-change detection, and the ranked movers report
+  that attributes a gate failure to the counters that moved.
+
 See ``docs/OBSERVABILITY.md`` for the event model and flag reference.
 """
 
@@ -127,6 +138,10 @@ class Obs:
     #: both stopped by finish AND the flight recorder
     server: "object | None" = None
     series: "object | None" = None
+    #: SLO plane (obs/slo.py): the alert evaluator watching the series
+    #: ring — runs whenever the recorder runs, stopped with the live
+    #: plane (its final tick sees the recorder's final sample)
+    alerts: "object | None" = None
     #: the phase currently open (obs.phase) and the workload under
     #: recording — what /status reports while the job runs
     current_phase: "str | None" = None
@@ -208,12 +223,36 @@ class Obs:
                                         stall_factor=stall)
             obs.sampler.start()
         if sample_s > 0:
-            from map_oxidize_tpu.obs.timeseries import TimeSeriesRecorder
+            from map_oxidize_tpu.obs.timeseries import (
+                DEFAULT_CAPACITY,
+                TimeSeriesRecorder,
+            )
 
+            # MOXT_SERIES_CAPACITY: test hook for ring-wraparound
+            # coverage — a tiny ring wraps in seconds instead of a
+            # 17-minute soak (tests/test_slo.py)
+            try:
+                cap = int(os.environ.get("MOXT_SERIES_CAPACITY", "")
+                          or DEFAULT_CAPACITY)
+            except ValueError:
+                cap = DEFAULT_CAPACITY
             obs.series = TimeSeriesRecorder(obs.registry,
                                             interval_s=sample_s,
-                                            heartbeat=obs.heartbeat)
+                                            capacity=cap,
+                                            heartbeat=obs.heartbeat,
+                                            obs=obs)
             obs.series.start()
+            # the SLO plane rides the series ring: default rules plus
+            # --slo-rules, evaluated at the sampling cadence; incident
+            # bundles land under --incident-dir (default: --crash-dir)
+            from map_oxidize_tpu.obs.slo import SloEvaluator, load_rules
+
+            obs.alerts = SloEvaluator(
+                obs, load_rules(getattr(config, "slo_rules", None)),
+                config=config, interval_s=sample_s,
+                incident_dir=(getattr(config, "incident_dir", None)
+                              or getattr(config, "crash_dir", None)))
+            obs.alerts.start()
         if obs_port >= 0:
             from map_oxidize_tpu.obs.serve import (
                 ObsServer,
@@ -295,6 +334,10 @@ class Obs:
             self.server.stop()
         if self.series is not None:
             self.series.stop()
+        if self.alerts is not None:
+            # after the recorder's final sample, so a condition that
+            # cleared at the very end still resolves in the timeline
+            self.alerts.stop()
 
     def finish_xprof(self) -> dict | None:
         """Close the job's XLA observatory window: stop the sampler,
@@ -339,6 +382,8 @@ class Obs:
                 doc["xprof"] = xprof_report
             if self.series is not None:
                 doc["series"] = self.series.export()
+            if self.alerts is not None:
+                doc["alerts"] = self.alerts.export()
             write_json_atomic(config.metrics_out, doc)
         trace = self.tracer.chrome_trace() if self.tracer.enabled else None
         if trace is not None:
@@ -353,11 +398,20 @@ class Obs:
         if getattr(config, "ledger_dir", None):
             from map_oxidize_tpu.obs import ledger
 
+            extra: dict = {}
             comms = self.registry.comms_table()
+            if comms:
+                extra["comms"] = comms
+            if self.alerts is not None and (self.alerts.fired_total
+                                            or self.alerts.resolved_total):
+                # the alert timeline rides the entry (the flat
+                # alerts/fired counter is already in the summary the
+                # gate compares)
+                extra["alerts"] = self.alerts.timeline_doc()
             ledger.append(config.ledger_dir, ledger.build_entry(
                 config, workload or "?", summary,
                 n_processes=self.n_processes,
-                extra={"comms": comms} if comms else None))
+                extra=extra or None))
         return summary, trace
 
     @contextlib.contextmanager

@@ -1,6 +1,6 @@
 """``python -m map_oxidize_tpu obs ...`` — observability artifact tools.
 
-Four subcommands, all pure host-side work (no jax, no backend init):
+Five subcommands, all pure host-side work (no jax, no backend init):
 
 * ``obs merge`` — combine a distributed run's per-process trace shards
   (``<trace_out>.proc<i>``) into one Chrome trace (pid = process slot)
@@ -19,13 +19,19 @@ Four subcommands, all pure host-side work (no jax, no backend init):
   directory: per-program compile counts with recompile causes,
   FLOPs/bytes from ``cost_analysis``, achieved-vs-peak utilization, and
   the dispatch-gap histogram summary.
+* ``obs trend`` — cross-run regression forensics over a run ledger (or
+  ``BENCH_r*.json`` rounds): per-counter/per-phase trajectories, step-
+  change detection against the median of prior entries, and a ranked
+  movers report — when a gate trips, the table that says WHICH counter
+  moved and when (``--json`` for the structured form).
 * ``obs top`` — live terminal view of a running job: polls the
   ``--obs-port`` server's ``/status`` and redraws phase, rows/sec, ETA,
   the compile/MFU table, HBM, and the comms table.  Curses-free (plain
-  ANSI redraw), so it works in any terminal and over ssh.  Pointed at a
-  RESIDENT job server (``python -m map_oxidize_tpu serve``) it also
-  renders the ``/jobs`` table — queued/running/done jobs with per-job
-  phase, rows/sec, and compile deltas — next to the single-job view.
+  ANSI redraw), so it works in any terminal and over ssh.  Renders the
+  SLO plane's ``/alerts`` panel (firing + recently-resolved) when the
+  evaluator is running, and — pointed at a RESIDENT job server
+  (``python -m map_oxidize_tpu serve``) — the ``/jobs`` table next to
+  the single-job view.
 """
 
 from __future__ import annotations
@@ -92,6 +98,33 @@ def build_obs_parser() -> argparse.ArgumentParser:
                    help="emit the structured report as JSON instead of "
                         "the rendered tables")
 
+    tr = sub.add_parser(
+        "trend", help="cross-run regression forensics: per-counter/per-"
+                      "phase trajectories over N ledger entries (or "
+                      "BENCH_r*.json rounds), step-change detection, and "
+                      "a ranked movers report attributing a gate failure "
+                      "to the counters that moved")
+    tr.add_argument("--ledger-dir", default=None,
+                    help="directory holding ledger.jsonl (omit when "
+                         "--bench files are given)")
+    tr.add_argument("--workload", default=None,
+                    help="filter the ledger to one workload (default: "
+                         "the workload with the most entries)")
+    tr.add_argument("--last", type=int, default=0,
+                    help="use only the newest N entries (0 = all)")
+    tr.add_argument("--bench", nargs="*", default=[], metavar="JSON",
+                    help="BENCH_r*.json round artifacts to trend instead "
+                         "of (or besides) a ledger")
+    tr.add_argument("--threshold-pct", type=float, default=25.0,
+                    help="step-change detection threshold (default 25)")
+    tr.add_argument("--top", type=int, default=10,
+                    help="movers to rank (default 10; 0 = all)")
+    tr.add_argument("--all-series", action="store_true",
+                    help="print every series' trajectory, not just "
+                         "phases + steps + movers")
+    tr.add_argument("--json", action="store_true",
+                    help="emit the structured analysis as JSON")
+
     t = sub.add_parser(
         "top", help="live terminal view of a running job: poll the "
                     "--obs-port server's /status and redraw")
@@ -117,6 +150,8 @@ def obs_main(argv: list[str]) -> int:
         return _xprof(args)
     if args.cmd == "top":
         return _top(args)
+    if args.cmd == "trend":
+        return _trend(args)
     return _diff(args)
 
 
@@ -260,6 +295,74 @@ def _diff(args) -> int:
     return 0
 
 
+# --- obs trend -------------------------------------------------------------
+
+
+def _trend(args) -> int:
+    import json
+
+    from map_oxidize_tpu.obs import ledger, trend
+
+    groups: list[tuple[str, list]] = []
+    if args.bench:
+        paths: list[str] = []
+        for spec in args.bench:
+            hits = sorted(glob.glob(spec))
+            paths += hits if hits else [spec]
+        try:
+            entries = trend.bench_rounds(paths)
+        except (OSError, ValueError) as e:
+            print(f"error: cannot read bench round: {e}", file=sys.stderr)
+            return 2
+        if len(entries) >= 2:
+            groups.append(("bench-rounds", entries))
+        else:
+            print(f"error: need >= 2 bench rounds, got {len(entries)}",
+                  file=sys.stderr)
+            return 2
+    if args.ledger_dir:
+        entries = ledger.read(args.ledger_dir, args.workload)
+        if not entries and not groups:
+            print(f"error: no ledger entries under {args.ledger_dir}"
+                  + (f" for workload {args.workload!r}" if args.workload
+                     else ""), file=sys.stderr)
+            return 2
+        by_wl: dict[str, list] = {}
+        for e in entries:
+            by_wl.setdefault(e.get("workload") or "?", []).append(e)
+        if args.workload is None and len(by_wl) > 1:
+            # default to the richest history; name the rest so the
+            # operator knows what to ask for
+            names = sorted(by_wl, key=lambda w: -len(by_wl[w]))
+            print(f"(ledger holds {len(by_wl)} workloads; trending "
+                  f"{names[0]!r} — pass --workload for "
+                  f"{', '.join(repr(n) for n in names[1:6])})")
+            by_wl = {names[0]: by_wl[names[0]]}
+        for wl, es in sorted(by_wl.items()):
+            if args.last and args.last > 1:
+                es = es[-args.last:]
+            if len(es) >= 2:
+                groups.append((wl, es))
+            else:
+                print(f"(workload {wl!r}: only {len(es)} entry — need "
+                      ">= 2 to trend)")
+    if not groups and not args.bench and not args.ledger_dir:
+        print("error: obs trend needs --ledger-dir and/or --bench files",
+              file=sys.stderr)
+        return 2
+    if not groups:
+        return 2
+    analyses = [trend.analyze(es, args.threshold_pct, args.top)
+                for _wl, es in groups]
+    if args.json:
+        print(json.dumps(analyses if len(analyses) > 1 else analyses[0],
+                         indent=1, sort_keys=True))
+        return 0
+    for a in analyses:
+        print(trend.render(a, show_series=1 if args.all_series else 0))
+    return 0
+
+
 # --- obs top ---------------------------------------------------------------
 
 
@@ -339,6 +442,32 @@ def render_status(doc: dict) -> str:
     return "\n".join(lines)
 
 
+def render_alerts(doc: dict) -> str:
+    """The SLO plane's ``/alerts`` document as an ``obs top`` panel:
+    firing alerts (rule, series, observed value) plus the recently
+    resolved tail.  Pure, so tests pin the rendering without a server."""
+    counts = doc.get("counts") or {}
+    firing = doc.get("firing") or []
+    resolved = doc.get("resolved") or []
+    head = (f"alerts: {len(firing)} firing "
+            f"(lifetime {counts.get('fired', 0)} fired / "
+            f"{counts.get('resolved', 0)} resolved)")
+    lines = [head]
+    def _g(v):
+        return f"{v:g}" if isinstance(v, (int, float)) else "?"
+
+    for a in firing[:8]:
+        lines.append(
+            f"  !! {a.get('severity', '?').upper():<8} {a['rule']}: "
+            f"{a['series']}={_g(a.get('value'))} "
+            f"({a.get('op', '?')} {_g(a.get('threshold'))})")
+    for e in resolved[-4:]:
+        lines.append(
+            f"  ok resolved {e['rule']}: {e['series']} "
+            f"(was {_g(e.get('value'))})")
+    return "\n".join(lines)
+
+
 def render_jobs(doc: dict) -> str:
     """The resident server's ``/jobs`` table as an ``obs top`` section.
     Pure, so tests pin the rendering without a server."""
@@ -397,6 +526,16 @@ def _top(args) -> int:
                 return 2
             seen_one = True
             frame = render_status(doc)
+            # the SLO plane's panel rides beside the job view (servers
+            # without an evaluator 404 here — skip silently)
+            try:
+                with urllib.request.urlopen(base + "/alerts",
+                                            timeout=5) as resp:
+                    alerts_doc = json.loads(resp.read())
+                if alerts_doc.get("schema") == "moxt-alerts-v1":
+                    frame += "\n" + render_alerts(alerts_doc)
+            except (urllib.error.URLError, OSError, ValueError):
+                pass
             # a resident job server carries /jobs too: render the table
             # (plain per-job telemetry servers 404 here — skip silently)
             try:

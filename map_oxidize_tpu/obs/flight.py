@@ -84,6 +84,10 @@ def _record(obs, config, exc, workload):
         metrics_doc["xprof"] = xprof_report
     if obs.series is not None:
         metrics_doc["series"] = obs.series.export()
+    if getattr(obs, "alerts", None) is not None:
+        # the alert timeline as of the abort: which SLOs were firing
+        # when the job died is first-order post-mortem evidence
+        metrics_doc["alerts"] = obs.alerts.export()
     trace = obs.tracer.chrome_trace() if obs.tracer.enabled else None
     if trace is not None:
         trace.insert(0, {"name": "moxt_meta", "ph": "M",

@@ -58,6 +58,12 @@ class Heartbeat:
     def set_phase(self, name: str) -> None:
         self.phase = name
 
+    def announce(self, line: str) -> None:
+        """Emit one out-of-band line immediately (alert transitions,
+        warnings) through the heartbeat's sink — bypasses the interval
+        throttle, which only paces the periodic progress lines."""
+        self._emit(line)
+
     def update(self, rows: int = 0, bytes_done: int | None = None,
                fraction: float | None = None) -> None:
         """Fold in progress from one block/iteration, then beat if the

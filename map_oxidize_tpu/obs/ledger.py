@@ -39,6 +39,7 @@ _NON_IDENTITY_FIELDS = frozenset({
     "progress_interval_s", "ledger_dir", "crash_dir",
     "hbm_sample_s", "stall_warn_factor",
     "obs_port", "obs_sample_s",
+    "slo_rules", "incident_dir",
     "dist_coordinator", "dist_process_id",
 })
 
@@ -257,6 +258,16 @@ def diff_entries(a: dict, b: dict, threshold_pct: float = 10.0,
                 regressions.append(
                     f"{name}: {va_n:,.0f} -> {vb_n:,.0f} bytes "
                     "(unexplained comms growth)")
+        elif name == "alerts/fired":
+            # SLO plane: alerts firing on a run that previously fired
+            # none (or more than before) is a regression at any
+            # threshold — the rules already encode the tolerance
+            if va != vb:
+                rows.append((name, va, vb, pct))
+            va_n = va if isinstance(va, (int, float)) else 0
+            if isinstance(vb, (int, float)) and vb > va_n:
+                regressions.append(
+                    f"{name}: {va_n:g} -> {vb:g} SLO alerts fired")
         elif name == "heartbeat/stalls":
             # stall episodes are evidence of a wedged feed loop or a
             # straggler-gated collective; ANY increase flags

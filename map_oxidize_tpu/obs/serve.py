@@ -17,7 +17,10 @@ shut down cleanly by ``Obs.finish`` *and* the flight recorder:
   table, and — on process 0 of a distributed run — the skew-aware
   aggregate estimate;
 * ``GET /series``  — the time-series ring
-  (:mod:`map_oxidize_tpu.obs.timeseries`) as aligned value lists.
+  (:mod:`map_oxidize_tpu.obs.timeseries`) as aligned value lists;
+* ``GET /alerts``  — the SLO plane (:mod:`map_oxidize_tpu.obs.slo`):
+  firing and recently-resolved alerts, per-rule state, and the bounded
+  transition timeline (``moxt-alerts-v1``).
 
 When a resident job service (:mod:`map_oxidize_tpu.serve`) attaches its
 scheduler, the SAME server additionally exposes the job plane — one
@@ -78,11 +81,52 @@ def sanitize_metric_name(name: str) -> str:
     return f"moxt_{s}"
 
 
+def sanitized_export_names(entries, cache: dict | None = None,
+                           used: set | None = None) -> dict:
+    """Collision-guarded sanitization: the flattening is lossy
+    (``comms/a/b`` and ``comms/a_b`` both sanitize to
+    ``moxt_comms_a_b``), and two registry keys silently exporting as ONE
+    Prometheus series would corrupt every query over it.  ``entries``
+    is an iterable of ``(kind, name)`` registry keys; the first taker
+    (deterministic: sorted by name then kind among the NEW keys of one
+    call) keeps the clean sanitized name, colliders get a stable
+    ``_x<hash>`` suffix derived from their ORIGINAL key.
+
+    ``cache``/``used`` make the assignment STICKY across calls (the
+    registry-lifetime maps ``prometheus_text`` passes): registry keys
+    are created lazily mid-run, and a later-created colliding key must
+    extend the mapping, never rename — an already-exported Prometheus
+    series keeps its name and identity on every subsequent scrape."""
+    import hashlib
+
+    cache = {} if cache is None else cache
+    used = set() if used is None else used
+    for kind, name in sorted(set(entries), key=lambda e: (e[1], e[0])):
+        if (kind, name) in cache:
+            continue
+        m = sanitize_metric_name(name)
+        if m in used:
+            digest = hashlib.sha1(f"{kind}:{name}".encode()).hexdigest()
+            n = 6
+            while f"{m}_x{digest[:n]}" in used and n < len(digest):
+                n += 1
+            m = f"{m}_x{digest[:n]}"
+        used.add(m)
+        cache[(kind, name)] = m
+    return cache
+
+
 def prometheus_text(registry, extra_labels: dict | None = None) -> str:
     """The registry in Prometheus text exposition format (v0.0.4):
     counters as ``counter``, gauges as ``gauge``, phase wall-clocks as a
     labeled ``moxt_phase_seconds`` gauge, histograms as summary
     quantiles plus ``_count``/``_sum``."""
+    def _num(v) -> str:
+        # full-precision exposition values: :g's 6 significant digits
+        # silently round large counters (byte totals, ms sums) — a
+        # scraper must read back exactly what the registry holds
+        return f"{float(v):.12g}"
+
     labels = ""
     if extra_labels:
         inner = ",".join(f'{k}="{v}"' for k, v in sorted(
@@ -105,7 +149,22 @@ def prometheus_text(registry, extra_labels: dict | None = None) -> str:
                   if isinstance(v, (int, float))
                   and not isinstance(v, bool)}
         hists = {k: (h.count, h.total, h.quantile(0.5), h.quantile(0.95),
-                     h.max) for k, h in registry.histograms.items()}
+                     h.max, h.cumulative_buckets())
+                 for k, h in registry.histograms.items()}
+    # collision-guarded name map for everything this scrape exports —
+    # bucketed histograms claim their `<name>_hist` spelling too, so the
+    # histogram-typed family can never shadow another metric.  The map
+    # is STICKY on the registry: keys created later never rename (or
+    # steal the name of) a series an earlier scrape already exported
+    entries = ([("counter", n) for n in counters]
+               + [("gauge", n) for n in gauges]
+               + [("hist", n) for n in hists]
+               + [("hist", f"{n}_hist") for n, row in hists.items()
+                  if row[5] is not None])
+    with registry._lock:
+        names = dict(sanitized_export_names(
+            entries, cache=registry._prom_names,
+            used=registry._prom_used))
     lines: list[str] = []
     if phases:
         lines.append("# TYPE moxt_phase_seconds gauge")
@@ -113,21 +172,34 @@ def prometheus_text(registry, extra_labels: dict | None = None) -> str:
             lines.append(
                 f'{_label("moxt_phase_seconds", {"phase": name})} {v:.6f}')
     for name, v in sorted(counters.items()):
-        m = sanitize_metric_name(name)
+        m = names[("counter", name)]
         lines.append(f"# TYPE {m} counter")
-        lines.append(f"{m}{labels} {v:g}")
+        lines.append(f"{m}{labels} {_num(v)}")
     for name, v in sorted(gauges.items()):
-        m = sanitize_metric_name(name)
+        m = names[("gauge", name)]
         lines.append(f"# TYPE {m} gauge")
-        lines.append(f"{m}{labels} {v:g}")
-    for name, (count, total, p50, p95, mx) in sorted(hists.items()):
-        m = sanitize_metric_name(name)
+        lines.append(f"{m}{labels} {_num(v)}")
+    for name, (count, total, p50, p95, mx, buckets) in sorted(
+            hists.items()):
+        m = names[("hist", name)]
         lines.append(f"# TYPE {m} summary")
         for q, v in (("0.5", p50), ("0.95", p95), ("1", mx)):
             if v is not None:
-                lines.append(f'{_label(m, {"quantile": q})} {v:g}')
-        lines.append(f"{m}_count{labels} {count:g}")
-        lines.append(f"{m}_sum{labels} {total:g}")
+                lines.append(f'{_label(m, {"quantile": q})} {_num(v)}')
+        lines.append(f"{m}_count{labels} {_num(count)}")
+        lines.append(f"{m}_sum{labels} {_num(total)}")
+        if buckets is not None:
+            # the REAL cumulative-bucket histogram, next to the summary
+            # under a distinct `_hist` family — stock PromQL
+            # histogram_quantile()/burn-rate queries work on it
+            hm = names[("hist", f"{name}_hist")]
+            lines.append(f"# TYPE {hm} histogram")
+            for le, acc in buckets:
+                le_s = "+Inf" if le == float("inf") else f"{le:g}"
+                lines.append(
+                    f'{_label(hm + "_bucket", {"le": le_s})} {_num(acc)}')
+            lines.append(f"{hm}_count{labels} {_num(count)}")
+            lines.append(f"{hm}_sum{labels} {_num(total)}")
     return "\n".join(lines) + "\n"
 
 
@@ -228,10 +300,20 @@ class _Handler(BaseHTTPRequestHandler):
         path = self.path.split("?", 1)[0]
         try:
             if path in ("/", "/healthz"):
-                eps = ["/metrics", "/status", "/series"]
+                eps = ["/metrics", "/status", "/series", "/alerts"]
                 if srv.scheduler is not None:
                     eps += ["/jobs", "/jobs/<id>"]
                 self._json({"endpoints": eps, "schema": STATUS_SCHEMA})
+            elif path == "/alerts":
+                ev = getattr(srv.obs, "alerts", None)
+                if ev is None:
+                    self._json({"error": "SLO evaluator not running "
+                                         "(needs the time-series "
+                                         "recorder: --obs-port or "
+                                         "--obs-sample-interval)"},
+                               code=404)
+                else:
+                    self._json(ev.export())
             elif path == "/jobs":
                 if srv.scheduler is None:
                     self._json({"error": "no job scheduler attached "

@@ -51,7 +51,7 @@ class TimeSeriesRecorder:
 
     def __init__(self, registry, interval_s: float = 1.0,
                  capacity: int = DEFAULT_CAPACITY, clock=time.time,
-                 heartbeat=None):
+                 heartbeat=None, obs=None):
         if interval_s <= 0:
             raise ValueError("interval_s must be positive")
         if capacity <= 0:
@@ -60,6 +60,11 @@ class TimeSeriesRecorder:
         #: optional heartbeat: its live row/byte progress becomes the
         #: ``progress/rows`` / ``progress/bytes_done`` series
         self.heartbeat = heartbeat
+        #: optional owning Obs bundle: with it, each tick also snapshots
+        #: the job's LIVE compile-ledger overlay into ``compile/*``
+        #: series — the registry only receives those counters at finish,
+        #: but the SLO plane's recompile rules need them mid-run
+        self.obs = obs
         self.interval_s = interval_s
         self.capacity = capacity
         self._clock = clock
@@ -115,6 +120,15 @@ class TimeSeriesRecorder:
             snap["progress/rows"] = hb.rows
             if hb.bytes_done:
                 snap["progress/bytes_done"] = hb.bytes_done
+        if self.obs is not None and getattr(self.obs, "xprof_base",
+                                            None) is not None:
+            from map_oxidize_tpu.obs.compile import job_overlay_delta
+
+            total = 0
+            for prog, d in job_overlay_delta(self.obs).items():
+                snap[f"compile/{prog}/compiles"] = d["compiles"]
+                total += d["compiles"]
+            snap["compile/total_compiles"] = total
         return snap
 
     def sample_once(self) -> None:
@@ -129,10 +143,27 @@ class TimeSeriesRecorder:
 
     # --- export -----------------------------------------------------------
 
-    def export(self) -> dict:
+    def latest_names(self) -> list[str]:
+        """Series names present in the NEWEST sample — the full current
+        name set (registry keys are never deleted, so the newest
+        snapshot is a superset of every older one).  Cheap: one locked
+        key-list copy, no aligned-list construction — what the SLO
+        evaluator globs against each tick before asking for a targeted
+        :meth:`export`."""
+        with self._lock:
+            if not self._ring:
+                return []
+            newest = (self._ring[self._head - 1]
+                      if len(self._ring) == self.capacity
+                      else self._ring[-1])
+            return list(newest[1].keys())
+
+    def export(self, only=None) -> dict:
         """The ``series`` document: timestamps plus aligned per-name value
         lists, oldest sample first.  Safe to call at any time (including
-        under concurrent ticks)."""
+        under concurrent ticks).  ``only`` (a set of names) restricts the
+        aligned-list construction to those series — the evaluator's
+        per-tick reads must not pay for the whole ring."""
         with self._lock:
             ordered = self._ring[self._head:] + self._ring[:self._head]
             samples_taken = self.samples_taken
@@ -140,7 +171,8 @@ class TimeSeriesRecorder:
         names: dict[str, None] = {}
         for _ts, snap in ordered:
             for k in snap:
-                names.setdefault(k)
+                if only is None or k in only:
+                    names.setdefault(k)
         series = {name: [snap.get(name) for _ts, snap in ordered]
                   for name in names}
         return {

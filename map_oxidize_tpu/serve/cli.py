@@ -55,6 +55,11 @@ def build_serve_parser() -> argparse.ArgumentParser:
                    default=d.obs_sample_s,
                    help="server telemetry cadence (time-series ring + "
                         "HBM sampler)")
+    p.add_argument("--slo-rules", default=d.slo_rules,
+                   help="SLO rule set for the server's alert evaluator "
+                        "(JSON file path or inline JSON; '' = built-in "
+                        "defaults).  Serve-scoped rules watch queue-wait "
+                        "p95, warm recompiles, and the HBM watermark")
     p.add_argument("-v", "--verbose", action="store_true")
     p.add_argument("-q", "--quiet", action="store_true")
     return p
@@ -79,6 +84,7 @@ def serve_main(argv: list[str]) -> int:
             idle_evict_s=args.idle_evict_s,
             drain_timeout_s=args.drain_timeout_s,
             obs_sample_s=args.obs_sample_interval,
+            slo_rules=args.slo_rules,
         ).validate()
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)

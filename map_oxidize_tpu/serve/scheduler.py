@@ -66,8 +66,17 @@ TERMINAL_STATES = frozenset({"done", "failed", "cancelled", "rejected"})
 RESERVED_OVERRIDES = frozenset({
     "input_path", "output_path", "obs_port", "obs_sample_s", "metrics",
     "metrics_out", "crash_dir", "ledger_dir", "progress", "trace_dir",
+    "incident_dir",
     "dist_coordinator", "dist_num_processes", "dist_process_id",
 })
+
+#: serve SLO latency histograms recorded on the SERVER-LIFETIME registry
+#: per finished job (cumulative Prometheus buckets at /metrics, summary
+#: quantiles beside them): how long submissions queued, how long HBM
+#: admission deferred them, and how long they ran
+QUEUE_WAIT_MS = "serve/queue_wait_ms"
+ADMISSION_WAIT_MS = "serve/admission_wait_ms"
+RUN_WALL_MS = "serve/run_wall_ms"
 
 
 class Job:
@@ -93,6 +102,9 @@ class Job:
         self.obs = None
         self.cancel_requested = False
         self.pending_cancel_reason: str | None = None
+        #: first time the HBM budget deferred this job (admission-wait
+        #: SLO evidence); None = admitted on first consideration
+        self.first_deferred_unix_s: float | None = None
         #: the driver's result object (in-process consumers; never
         #: serialized whole) and its flat metrics summary (the /jobs doc)
         self.result = None
@@ -116,6 +128,13 @@ class Scheduler:
         self._seq = 0
         self._draining = False
         self._stop = False
+        self._done_count = 0
+        #: the SERVER-LIFETIME metrics registry (the resident server's
+        #: own obs bundle attaches it): per-job SLO latency histograms
+        #: and the warm-recompile counter land here, where the server's
+        #: time-series ring and SLO evaluator watch them.  None for a
+        #: bare Scheduler (unit tests) — recording is skipped
+        self.server_registry = None
         self.started_at = time.time()
         #: set by request_shutdown (the POST /shutdown endpoint and the
         #: SIGTERM handler) — the server's main loop waits on it
@@ -233,6 +252,7 @@ class Scheduler:
             metrics=False,                # no per-job stdout metrics line
             metrics_out=os.path.join(job_dir, "metrics.json"),
             crash_dir=os.path.join(job_dir, "crash"),
+            incident_dir=os.path.join(job_dir, "incidents"),
             ledger_dir=self.ledger_dir,
             progress=False,
         ).validate()                      # ValueError -> caller (HTTP 400)
@@ -359,6 +379,8 @@ class Scheduler:
                 self._queue.remove(jid)
                 job.defer_reason = None
                 return job
+            if job.first_deferred_unix_s is None:
+                job.first_deferred_unix_s = time.time()
             job.defer_reason = reason     # "defer" (reject happened at
             #                               submit; a later budget shrink
             #                               keeps the job waiting, named)
@@ -407,10 +429,50 @@ class Scheduler:
                 self._running.discard(job.id)
                 self.admission.release(job.est_hbm_bytes)
                 self.corpora.touch(job.config.input_path)
+                warm_before = self._done_count
+                if state == "done":
+                    self._done_count += 1
                 self._prune_locked()
                 self._cond.notify_all()
+            # SLO latency metrics OUTSIDE the scheduler lock (the
+            # registry locks itself; nothing here may serialize the
+            # pop loop or /jobs scrapes)
+            self._record_slo_metrics(job, state, warm_before)
         _log.info("[serve] %s %s%s", job.id, state,
                   f": {reason}" if reason else "")
+
+    def _record_slo_metrics(self, job: Job, state: str,
+                            warm_before: int) -> None:
+        """Per-job serve SLO evidence into the SERVER-LIFETIME registry:
+        queue-wait / admission-wait / run-wall histograms (cumulative
+        Prometheus buckets at /metrics) plus per-state job counters and
+        the warm-recompile counter — compile deltas on any job after the
+        first completed one, the signal the ``warm-serve-recompile``
+        default SLO rule watches (DrJAX's flat-program-count
+        invariant)."""
+        reg = self.server_registry
+        if reg is None:
+            return
+        from map_oxidize_tpu.obs.metrics import LATENCY_BUCKETS_MS
+
+        reg.count("serve/jobs_total", 1)
+        reg.count(f"serve/jobs_{state}", 1)
+        if job.started_unix_s is not None:
+            reg.observe(QUEUE_WAIT_MS,
+                        (job.started_unix_s - job.submitted_unix_s) * 1e3,
+                        buckets=LATENCY_BUCKETS_MS)
+            reg.observe(ADMISSION_WAIT_MS,
+                        ((job.started_unix_s - job.first_deferred_unix_s)
+                         * 1e3 if job.first_deferred_unix_s else 0.0),
+                        buckets=LATENCY_BUCKETS_MS)
+            if job.finished_unix_s is not None:
+                reg.observe(RUN_WALL_MS,
+                            (job.finished_unix_s - job.started_unix_s)
+                            * 1e3, buckets=LATENCY_BUCKETS_MS)
+        if state == "done" and warm_before >= 1:
+            compiles = job.summary.get("compile/total_compiles") or 0
+            if compiles > 0:
+                reg.count("serve/warm_compiles", compiles)
 
     def _prune_locked(self) -> None:
         """Bound the job history: a resident process must not grow RSS
@@ -499,6 +561,8 @@ class Scheduler:
             row["deadline_unix_s"] = round(job.deadline_unix_s, 3)
         if job.started_unix_s is not None:
             row["started_unix_s"] = round(job.started_unix_s, 3)
+            row["queue_wait_s"] = round(
+                job.started_unix_s - job.submitted_unix_s, 3)
         if job.finished_unix_s is not None:
             row["finished_unix_s"] = round(job.finished_unix_s, 3)
             if job.started_unix_s is not None:

@@ -25,6 +25,7 @@ stops last so a watcher sees the drain happen.
 
 from __future__ import annotations
 
+import os
 import signal
 import threading
 
@@ -50,14 +51,23 @@ class ResidentServer:
         self.scheduler = Scheduler(cfg, runner=runner)
         # the server's own obs bundle: a synthetic job config switches on
         # the time-series ring + HBM sampler (admission evidence) but NOT
-        # a second HTTP server — this class owns the one plane below
+        # a second HTTP server — this class owns the one plane below.
+        # The SLO evaluator rides the same ring; serve-scoped rules
+        # (queue-wait p95, warm recompiles, HBM watermark) arm because
+        # the bundle's workload is "serve", and incident bundles land in
+        # the spool
         self._obs_config = JobConfig(
             input_path="", output_path="", metrics=False,
             obs_port=-1, obs_sample_s=cfg.obs_sample_s,
             hbm_sample_s=cfg.obs_sample_s,
+            slo_rules=cfg.slo_rules or None,
+            incident_dir=os.path.join(cfg.spool_dir, "incidents"),
         )
         self.obs = Obs.from_config(self._obs_config)
         self.obs.workload = "serve"
+        # per-job SLO latency metrics + the warm-recompile counter land
+        # on THIS registry, where the ring and the evaluator watch them
+        self.scheduler.server_registry = self.obs.registry
         self.http = ObsServer(self.obs, self._obs_config, cfg.port,
                               host=cfg.host, scheduler=self.scheduler)
         # finish/stop_live (and the flight recorder, were the server body
@@ -96,6 +106,16 @@ class ResidentServer:
             # under the scheduler lock, so probes/reads must be
             # cached-client lookups, never a blocking backend init
             self.scheduler.admission.mark_backend_ready()
+            # publish the probed budget as a gauge: the hbm-watermark
+            # SLO rule evaluates live HBM as a fraction of it (the rule
+            # stays dormant while the denominator is absent/zero)
+            try:
+                budget = self.scheduler.admission.doc().get(
+                    "budget_bytes") or 0
+                if budget:
+                    self.obs.registry.set("hbm/budget_bytes", budget)
+            except Exception as e:  # pragma: no cover - defensive
+                _log.debug("budget gauge publish failed: %s", e)
 
     @property
     def url(self) -> str:

@@ -70,6 +70,9 @@ python -m map_oxidize_tpu obs xprof "$smoke/metrics.json" | head -5
 python -m map_oxidize_tpu obs diff --ledger-dir "$smoke/ledger"
 python -m map_oxidize_tpu obs diff --ledger-dir "$smoke/ledger" \
     --gate -- -1 -1
+# cross-run forensics render from the same two entries (the movers
+# report is what a gate failure gets attributed with)
+python -m map_oxidize_tpu obs trend --ledger-dir "$smoke/ledger" | head -8
 
 echo "== dispatch-floor smoke =="
 # scan-batched streamed k-means: a center-seeded corpus streams through
@@ -194,6 +197,10 @@ while time.monotonic() < deadline:
                 prom = p
         if series is None:
             series = json.loads(get("/series"))
+        # default SLO rules must stay SILENT on this healthy run
+        a = json.loads(get("/alerts"))
+        assert a["schema"] == "moxt-alerts-v1", a
+        assert not a["firing"], f"default rules fired mid-run: {a['firing']}"
         s = json.loads(get("/status"))
         connected, fails = 1, 0
     except OSError:
@@ -227,7 +234,88 @@ m = json.load(open(f"{sys.argv[1]}/metrics_live.json"))
 assert m["series"]["schema"] == "moxt-series-v1", "series section missing"
 assert any(r["program"] == "shuffle/merge" for r in m["comms"]), \
     "comms table missing from the metrics document"
-print("final metrics doc carries series + comms tables")
+# the default-rules evaluator ran for the whole job and fired NOTHING
+al = m.get("alerts") or {}
+assert al.get("schema") == "moxt-alerts-v1", "alerts section missing"
+assert al["counts"]["fired"] == 0, \
+    f"default SLO rules fired on a clean run: {al['timeline']}"
+print("final metrics doc carries series + comms + silent alerts")
+EOF
+
+echo "== SLO alert smoke =="
+# an injected rule that must FIRE mid-run (rows below a floor the job
+# eventually passes) and RESOLVE when the condition clears — visible
+# live at /alerts, as an incident bundle, and in the exported timeline
+cat > "$smoke/slo_rules.json" <<'JSON'
+{"defaults": false, "rules": [
+ {"name": "smoke-rows-floor", "metric": "progress/rows",
+  "op": "<", "threshold": 20000, "kind": "value"}]}
+JSON
+export MOXT_OBS_PORT_FILE="$smoke/alert_port.txt"
+rm -f "$smoke/alert_port.txt"
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python -m map_oxidize_tpu wordcount "$smoke/corpus_live.txt" \
+    --output "$smoke/out_alert.txt" --num-shards 8 --num-chunks 48 \
+    --batch-size 512 --quiet --obs-port 0 --obs-sample-interval 0.05 \
+    --slo-rules "$smoke/slo_rules.json" \
+    --incident-dir "$smoke/incidents" \
+    --metrics-out "$smoke/metrics_alert.json" > /dev/null &
+alert_job=$!
+trap 'kill "$alert_job" 2>/dev/null; rm -rf "$smoke"' EXIT
+python - "$smoke" <<'EOF'
+import json, sys, time, urllib.request
+d = sys.argv[1]
+deadline = time.monotonic() + 180
+port = None
+while time.monotonic() < deadline and port is None:
+    try:
+        port = int(open(f"{d}/alert_port.txt").read().split()[1])
+    except (OSError, IndexError, ValueError):
+        time.sleep(0.01)
+assert port, "obs server port never appeared for the alert smoke"
+url = f"http://127.0.0.1:{port}/alerts"
+fired_seen = resolved_seen = False
+connected = fails = 0
+while time.monotonic() < deadline:
+    try:
+        a = json.loads(urllib.request.urlopen(url, timeout=5).read())
+        connected, fails = 1, 0
+    except OSError:
+        fails += 1
+        if connected and fails > 200:
+            break  # server gone ~2s = job finished
+        time.sleep(0.01)
+        continue
+    assert a["schema"] == "moxt-alerts-v1"
+    if a["firing"]:
+        assert a["firing"][0]["rule"] == "smoke-rows-floor"
+        fired_seen = True
+    if a["counts"]["resolved"] >= 1:
+        resolved_seen = True
+    if fired_seen and resolved_seen:
+        break
+    time.sleep(0.01)
+assert fired_seen, "injected rule never seen firing at /alerts"
+print(f"live /alerts OK: firing seen, resolved live={resolved_seen}")
+EOF
+wait "$alert_job"
+trap 'rm -rf "$smoke"' EXIT
+unset MOXT_OBS_PORT_FILE
+python - "$smoke" <<'EOF'
+import glob, json, sys
+d = sys.argv[1]
+m = json.load(open(f"{d}/metrics_alert.json"))
+events = [e["event"] for e in m["alerts"]["timeline"]]
+assert events == ["fired", "resolved"], \
+    f"expected the rule to fire then resolve, got {events}"
+assert m["counters"]["alerts/fired"] == 1
+bundles = glob.glob(f"{d}/incidents/incident_*/incident.json")
+assert len(bundles) == 1, f"expected 1 incident bundle, got {bundles}"
+inc = json.load(open(bundles[0]))
+assert inc["schema"] == "moxt-incident-v1"
+assert inc["rule"]["name"] == "smoke-rows-floor"
+assert inc["status"]["schema"] == "moxt-status-v1"
+print("alert smoke OK: fired -> resolved, incident bundle landed")
 EOF
 
 echo "== serve smoke =="

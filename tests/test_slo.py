@@ -1,0 +1,726 @@
+"""SLO & alerting plane + cross-run forensics (ISSUE-9 tentpole).
+
+Unit layer: rule parsing/validation, the value/delta/rate observation
+kinds, the for_s debounce and after_s arming, ring-wraparound
+correctness for windowed rules, incident bundles, the Prometheus
+cumulative-bucket histogram export and the sanitized-name collision
+guard, and the ``obs trend`` movers/step analysis.
+
+Integration layer: an injected rule firing and resolving on a live
+``/alerts`` endpoint (with the heartbeat line and the ``obs top``
+panel), default rules staying silent on a healthy run, and the serve
+scheduler's per-job latency histograms.
+"""
+
+import json
+import os
+import re
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from map_oxidize_tpu.config import JobConfig, ServeConfig
+from map_oxidize_tpu.obs import Heartbeat, MetricsRegistry, Obs
+from map_oxidize_tpu.obs.metrics import LATENCY_BUCKETS_MS
+from map_oxidize_tpu.obs.serve import (
+    prometheus_text,
+    sanitized_export_names,
+)
+from map_oxidize_tpu.obs.slo import (
+    DEFAULT_RULES,
+    MAX_INCIDENTS,
+    SloEvaluator,
+    SloRule,
+    load_rules,
+)
+from map_oxidize_tpu.obs.timeseries import TimeSeriesRecorder
+from map_oxidize_tpu.obs.trace import Tracer
+
+
+def _write_corpus(path, lines=300):
+    with open(path, "wb") as f:
+        f.write(b"the quick brown fox jumps over the lazy dog\n" * lines)
+    return str(path)
+
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _bundle(clock, capacity=64, interval_s=1.0):
+    """A minimal Obs bundle with a fake-clock series recorder attached —
+    the deterministic substrate every evaluator unit test drives by
+    hand (no threads)."""
+    obs = Obs(registry=MetricsRegistry(), tracer=Tracer(enabled=False))
+    obs.tracer.wall_start = clock()
+    obs.series = TimeSeriesRecorder(obs.registry, interval_s=interval_s,
+                                    capacity=capacity, clock=clock)
+    return obs
+
+
+def _evaluator(obs, rules, clock, **kw):
+    return SloEvaluator(obs, rules, clock=clock, **kw)
+
+
+# --- rules ------------------------------------------------------------------
+
+
+def test_rule_validation_rejects_bad_specs():
+    with pytest.raises(ValueError):
+        SloRule(name="x", metric="m", kind="bogus").validate()
+    with pytest.raises(ValueError):
+        SloRule(name="x", metric="m", op="==").validate()
+    with pytest.raises(ValueError):
+        SloRule(name="x", metric="m", scope="cluster").validate()
+    with pytest.raises(ValueError):
+        SloRule(name="x", metric="m", window_s=0).validate()
+    with pytest.raises(ValueError):   # denominator is value-rule-only
+        SloRule(name="x", metric="m", kind="delta",
+                denominator="d").validate()
+    with pytest.raises(ValueError):   # unknown field = a typo, not noise
+        load_rules('[{"name": "x", "metric": "m", "treshold": 3}]')
+
+
+def test_load_rules_extend_replace_override():
+    assert [r.name for r in load_rules(None)] == \
+        [d["name"] for d in DEFAULT_RULES]
+    # a list EXTENDS the defaults
+    got = load_rules('[{"name": "mine", "metric": "m"}]')
+    assert "mine" in {r.name for r in got}
+    assert len(got) == len(DEFAULT_RULES) + 1
+    # an object with defaults:false REPLACES them
+    got = load_rules('{"defaults": false, '
+                     '"rules": [{"name": "only", "metric": "m"}]}')
+    assert [r.name for r in got] == ["only"]
+    # same-name rule OVERRIDES the default (tunable thresholds)
+    got = load_rules('[{"name": "mfu-floor", "metric": "xprof/*/mfu_pct",'
+                     ' "op": "<", "threshold": 40}]')
+    floor = next(r for r in got if r.name == "mfu-floor")
+    assert floor.threshold == 40
+    assert len(got) == len(DEFAULT_RULES)
+
+
+def test_load_rules_from_file(tmp_path):
+    p = tmp_path / "rules.json"
+    p.write_text(json.dumps([{"name": "f", "metric": "m"}]))
+    assert "f" in {r.name for r in load_rules(str(p))}
+    with pytest.raises(OSError):
+        load_rules(str(tmp_path / "missing.json"))
+    with pytest.raises(ValueError):
+        JobConfig(input_path="x",
+                  slo_rules=str(tmp_path / "missing.json")).validate()
+
+
+# --- evaluation: kinds, debounce, arming, wraparound ------------------------
+
+
+def test_value_rule_fires_and_resolves():
+    clock = _Clock()
+    obs = _bundle(clock)
+    rule = SloRule(name="low", metric="work/level", op="<",
+                   threshold=100).validate()
+    ev = _evaluator(obs, [rule], clock)
+    obs.registry.set("work/level", 5)
+    obs.series.sample_once()
+    events = ev.evaluate_once()
+    assert [e["event"] for e in events] == ["fired"]
+    assert events[0]["rule"] == "low" and events[0]["value"] == 5
+    assert obs.registry.counters["alerts/fired"] == 1
+    assert obs.registry.gauges["alerts/firing"] == 1
+    # still firing: no duplicate event
+    clock.t += 1
+    obs.series.sample_once()
+    assert ev.evaluate_once() == []
+    # condition clears -> resolved
+    obs.registry.set("work/level", 500)
+    clock.t += 1
+    obs.series.sample_once()
+    events = ev.evaluate_once()
+    assert [e["event"] for e in events] == ["resolved"]
+    assert obs.registry.counters["alerts/resolved"] == 1
+    assert obs.registry.gauges["alerts/firing"] == 0
+    assert [e["event"] for e in ev.timeline] == ["fired", "resolved"]
+
+
+def test_for_s_debounce_requires_sustained_condition():
+    clock = _Clock()
+    obs = _bundle(clock)
+    rule = SloRule(name="slow", metric="g", op=">", threshold=10,
+                   for_s=5.0).validate()
+    ev = _evaluator(obs, [rule], clock)
+    obs.registry.set("g", 50)
+    obs.series.sample_once()
+    assert ev.evaluate_once() == []          # pending, not firing
+    clock.t += 2
+    obs.series.sample_once()
+    assert ev.evaluate_once() == []          # still inside for_s
+    # a dip resets the debounce
+    obs.registry.set("g", 1)
+    clock.t += 1
+    obs.series.sample_once()
+    assert ev.evaluate_once() == []
+    obs.registry.set("g", 50)
+    clock.t += 1
+    obs.series.sample_once()
+    assert ev.evaluate_once() == []          # pending restarted
+    clock.t += 6
+    obs.series.sample_once()
+    events = ev.evaluate_once()
+    assert [e["event"] for e in events] == ["fired"]
+
+
+def test_after_s_excludes_cold_start():
+    clock = _Clock()
+    obs = _bundle(clock)
+    rule = SloRule(name="warmed", metric="g", op=">", threshold=0,
+                   after_s=300).validate()
+    ev = _evaluator(obs, [rule], clock)
+    obs.registry.set("g", 5)
+    obs.series.sample_once()
+    assert ev.evaluate_once() == []          # job too young
+    clock.t += 301
+    obs.series.sample_once()
+    assert [e["event"] for e in ev.evaluate_once()] == ["fired"]
+
+
+def test_delta_rule_fires_then_resolves_as_window_passes():
+    clock = _Clock()
+    obs = _bundle(clock)
+    rule = SloRule(name="grew", metric="c", kind="delta", op=">",
+                   threshold=0, window_s=10).validate()
+    ev = _evaluator(obs, [rule], clock)
+    obs.registry.count("c", 1)
+    obs.series.sample_once()
+    clock.t += 5
+    obs.registry.count("c", 3)
+    obs.series.sample_once()
+    # delta clamps to the oldest sample when the window reaches past it
+    assert [e["event"] for e in ev.evaluate_once()] == ["fired"]
+    # 20s later with no increments, the window holds no growth
+    clock.t += 20
+    obs.series.sample_once()
+    assert [e["event"] for e in ev.evaluate_once()] == ["resolved"]
+
+
+def test_delta_rule_fires_on_first_increment_of_lazy_counter():
+    """Counters are created lazily on their first increment — and that
+    FIRST increment is the whole signal for stall/warm-recompile rules:
+    the tick before the series' first sample proves it was absent, so
+    the baseline is 0 there, not the post-increment value."""
+    clock = _Clock()
+    obs = _bundle(clock)
+    rule = SloRule(name="stall", metric="heartbeat/stalls",
+                   kind="delta", op=">", threshold=0,
+                   window_s=120).validate()
+    ev = _evaluator(obs, [rule], clock)
+    for _ in range(3):                       # ring has pre-stall history
+        obs.series.sample_once()
+        clock.t += 1
+    assert ev.evaluate_once() == []          # series absent: nothing
+    obs.registry.count("heartbeat/stalls", 1)   # THE first episode
+    obs.series.sample_once()
+    events = ev.evaluate_once()
+    assert [e["event"] for e in events] == ["fired"]
+    assert events[0]["value"] == 1.0
+
+
+def test_rule_numeric_fields_type_checked_at_config_time():
+    """The config-time validation promise: a string threshold must fail
+    at load, not TypeError out of every evaluator tick."""
+    with pytest.raises(ValueError):
+        load_rules('[{"name": "x", "metric": "m", "threshold": "5000"}]')
+    with pytest.raises(ValueError):
+        load_rules('[{"name": "x", "metric": "m", "window_s": "60"}]')
+    with pytest.raises(ValueError):
+        JobConfig(input_path="x", slo_rules='[{"name": "x", "metric": '
+                  '"m", "threshold": "5000"}]').validate()
+
+
+def test_scope_filters_serve_rules_off_jobs():
+    clock = _Clock()
+    obs = _bundle(clock)
+    rule = SloRule(name="s", metric="g", op=">", threshold=0,
+                   scope="serve").validate()
+    ev = _evaluator(obs, [rule], clock)
+    obs.registry.set("g", 5)
+    obs.series.sample_once()
+    assert ev.evaluate_once() == []          # job scope: serve rule off
+    obs.workload = "serve"
+    assert [e["event"] for e in ev.evaluate_once()] == ["fired"]
+
+
+def test_denominator_rule_dormant_until_budget_exists():
+    clock = _Clock()
+    obs = _bundle(clock)
+    rule = SloRule(name="hbm", metric="hbm/live_bytes_*", op=">",
+                   threshold=0.95, denominator="hbm/budget_bytes"
+                   ).validate()
+    ev = _evaluator(obs, [rule], clock)
+    obs.registry.set("hbm/live_bytes_device0", 96)
+    obs.series.sample_once()
+    assert ev.evaluate_once() == []          # no budget gauge yet
+    obs.registry.set("hbm/budget_bytes", 100)
+    clock.t += 1
+    obs.series.sample_once()
+    events = ev.evaluate_once()
+    assert [e["event"] for e in events] == ["fired"]
+    assert events[0]["value"] == pytest.approx(0.96)
+
+
+def test_rate_rule_correct_across_ring_wraparound():
+    """A 4-slot ring wraps long before the window: the rate must clamp
+    to the oldest SURVIVING sample and divide by the actual span — a
+    wrapped ring must never fabricate a burst (or lose the signal)."""
+    clock = _Clock()
+    obs = _bundle(clock, capacity=4)
+    rule = SloRule(name="rate", metric="c", kind="rate", op=">",
+                   threshold=4.9, window_s=1000).validate()
+    ev = _evaluator(obs, [rule], clock)
+    for _i in range(10):                     # 5 units/s for 10s
+        obs.registry.count("c", 5)
+        obs.series.sample_once()
+        clock.t += 1
+    assert obs.series.samples_taken == 10    # ring wrapped (cap 4)
+    export = obs.series.export()
+    assert len(export["t_unix_s"]) == 4
+    assert export["t_unix_s"] == sorted(export["t_unix_s"])
+    events = ev.evaluate_once(now=clock.t)
+    assert [e["event"] for e in events] == ["fired"]
+    # observed rate ~5/s over the 3s surviving span, not an artifact of
+    # the nominal 1000s window
+    assert events[0]["value"] == pytest.approx(5.0)
+
+
+def test_series_capacity_env_hook(tmp_path, monkeypatch):
+    """MOXT_SERIES_CAPACITY shrinks the ring for long-serve wraparound
+    simulation without a 17-minute soak."""
+    monkeypatch.setenv("MOXT_SERIES_CAPACITY", "8")
+    corpus = _write_corpus(tmp_path / "c.txt", lines=5)
+    cfg = JobConfig(input_path=corpus, output_path="",
+                    obs_sample_s=0.01).validate()
+    obs = Obs.from_config(cfg)
+    try:
+        assert obs.series.capacity == 8
+        for _ in range(20):
+            obs.series.sample_once()
+        assert len(obs.series.export()["t_unix_s"]) == 8
+    finally:
+        obs.finish(cfg, "wordcount")
+
+
+# --- incidents --------------------------------------------------------------
+
+
+def test_incident_bundle_and_cap(tmp_path):
+    clock = _Clock()
+    obs = _bundle(clock)
+    corpus = _write_corpus(tmp_path / "c.txt", lines=3)
+    cfg = JobConfig(input_path=corpus, output_path="").validate()
+    rule = SloRule(name="inc/rule", metric="g", op=">",
+                   threshold=0).validate()
+    ev = _evaluator(obs, [rule], clock, config=cfg,
+                    incident_dir=str(tmp_path / "incidents"))
+    obs.registry.set("g", 7)
+    obs.series.sample_once()
+    assert [e["event"] for e in ev.evaluate_once()] == ["fired"]
+    bundles = os.listdir(tmp_path / "incidents")
+    assert len(bundles) == 1 and bundles[0].startswith("incident_")
+    assert "inc_rule" in bundles[0]          # rule name path-sanitized
+    doc = json.load(open(tmp_path / "incidents" / bundles[0]
+                         / "incident.json"))
+    assert doc["schema"] == "moxt-incident-v1"
+    assert doc["rule"]["name"] == "inc/rule" and doc["value"] == 7
+    assert doc["window"]["values"][-1] == 7
+    assert doc["status"]["schema"] == "moxt-status-v1"
+    # the cap: an alert storm stops writing bundles, keeps counting
+    ev.incidents_written = MAX_INCIDENTS
+    obs.registry.set("g", 0)
+    clock.t += 1
+    obs.series.sample_once()
+    ev.evaluate_once()                       # resolved
+    obs.registry.set("g", 9)
+    clock.t += 1
+    obs.series.sample_once()
+    assert [e["event"] for e in ev.evaluate_once()] == ["fired"]
+    assert ev.fired_total == 2
+    assert len(os.listdir(tmp_path / "incidents")) == 1
+
+
+# --- announcement + export --------------------------------------------------
+
+
+def test_alert_lines_ride_the_heartbeat():
+    clock = _Clock()
+    obs = _bundle(clock)
+    lines = []
+    obs.heartbeat = Heartbeat(interval_s=10.0, clock=lambda: clock.t,
+                              emit=lines.append)
+    rule = SloRule(name="loud", metric="g", op=">", threshold=0).validate()
+    ev = _evaluator(obs, [rule], clock)
+    obs.registry.set("g", 3)
+    obs.series.sample_once()
+    ev.evaluate_once()
+    obs.registry.set("g", 0)
+    clock.t += 1
+    obs.series.sample_once()
+    ev.evaluate_once()
+    assert any("[alert] FIRING loud" in line for line in lines)
+    assert any("[alert] resolved loud" in line for line in lines)
+
+
+def test_alerts_export_and_top_panel():
+    from map_oxidize_tpu.obs.cli import render_alerts
+
+    clock = _Clock()
+    obs = _bundle(clock)
+    rules = [SloRule(name="a", metric="g", op=">", threshold=1).validate(),
+             SloRule(name="b", metric="h", op=">", threshold=1,
+                     severity="critical").validate()]
+    ev = _evaluator(obs, rules, clock)
+    obs.registry.set("g", 5)
+    obs.registry.set("h", 5)
+    obs.series.sample_once()
+    ev.evaluate_once()
+    obs.registry.set("h", 0)
+    clock.t += 1
+    obs.series.sample_once()
+    ev.evaluate_once()
+    doc = ev.export()
+    assert doc["schema"] == "moxt-alerts-v1"
+    assert doc["counts"] == {"fired": 2, "resolved": 1, "incidents": 0}
+    assert [f["rule"] for f in doc["firing"]] == ["a"]
+    assert [r["rule"] for r in doc["resolved"]] == ["b"]
+    assert len(doc["rules"]) == 2 and doc["rules"][0]["states"]
+    frame = render_alerts(doc)
+    assert "1 firing" in frame
+    assert "!! WARNING  a: g=5" in frame
+    assert "ok resolved b: h" in frame
+
+
+# --- ledger gate + trend forensics ------------------------------------------
+
+
+def _entry(ts, metrics, workload="wc", phases=None):
+    return {"ts_unix_s": ts, "version": "1", "config_hash": "cfg",
+            "workload": workload, "corpus_bytes": 1000, "n_processes": 1,
+            "phases_s": dict(phases or {"map+reduce": 1.0}),
+            "metrics": dict(metrics)}
+
+
+def test_ledger_diff_flags_alert_firing():
+    from map_oxidize_tpu.obs import ledger
+
+    a = _entry(1, {"alerts/fired": 0})
+    b = _entry(2, {"alerts/fired": 2})
+    diff = ledger.diff_entries(a, b)
+    assert any("SLO alerts fired" in r for r in diff["regressions"])
+    # equal counts: no flag
+    diff = ledger.diff_entries(b, _entry(3, {"alerts/fired": 2}))
+    assert not diff["regressions"]
+
+
+def test_trend_movers_rank_injected_regression_first():
+    from map_oxidize_tpu.obs import trend
+
+    base = {"rate": 1000.0, "comms/psum/fit/bytes": 1_000_000,
+            "records_in": 5000}
+    entries = [_entry(i, base, phases={"map+reduce": 1.0})
+               for i in range(1, 4)]
+    bad = dict(base, **{"comms/psum/fit/bytes": 10_000_000})
+    entries.append(_entry(4, bad, phases={"map+reduce": 1.05}))
+    mv = trend.movers(entries)
+    assert mv[0]["name"] == "comms/psum/fit/bytes"
+    assert mv[0]["rank"] == 1 and mv[0]["pct"] == pytest.approx(900.0)
+    assert mv[0]["direction"] == "moved"
+    steps = trend.detect_steps(trend.trajectories(entries))
+    assert steps and steps[0]["name"] == "comms/psum/fit/bytes"
+    assert steps[0]["index"] == 3
+    # a rate DROP is annotated as the regression direction
+    slow = [_entry(i, {"rate": 1000.0}) for i in range(1, 4)]
+    slow.append(_entry(4, {"rate": 500.0}))
+    mv = trend.movers(slow)
+    assert mv[0]["name"] == "rate" and mv[0]["direction"] == "regressed"
+
+
+def test_trend_cli_json_roundtrip(tmp_path, capsys):
+    from map_oxidize_tpu.obs import ledger
+    from map_oxidize_tpu.obs.cli import obs_main
+
+    ldir = tmp_path / "ledger"
+    base = {"rate": 100.0, "spill/rows": 10}
+    for i in range(1, 4):
+        ledger.append(str(ldir), _entry(i, base))
+    ledger.append(str(ldir), _entry(4, dict(base, **{"spill/rows": 900})))
+    rc = obs_main(["trend", "--ledger-dir", str(ldir), "--json"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["n_entries"] == 4 and doc["workload"] == "wc"
+    assert doc["movers"][0]["name"] == "spill/rows"
+    assert doc["movers"][0]["direction"] == "regressed"
+    # human-readable form names the mover too
+    rc = obs_main(["trend", "--ledger-dir", str(ldir)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "spill/rows" in out and "movers" in out
+    # too little history is a named refusal, not a crash
+    rc = obs_main(["trend", "--ledger-dir", str(tmp_path / "empty")])
+    assert rc == 2
+
+
+def test_trend_bench_rounds(tmp_path, capsys):
+    from map_oxidize_tpu.obs import trend
+    from map_oxidize_tpu.obs.cli import obs_main
+
+    for i, kv in enumerate([(10.0, 1.0), (11.0, 1.1), (11.5, 0.4)], 1):
+        (tmp_path / f"BENCH_r{i:02d}.json").write_text(json.dumps(
+            {"parsed": {"value": kv[0],
+                        "workloads": {"distinct_256mb": kv[1]}}}))
+    entries = trend.bench_rounds(
+        sorted(str(p) for p in tmp_path.glob("BENCH_r*.json")))
+    assert len(entries) == 3
+    mv = trend.movers(entries)
+    assert mv[0]["name"] == "workloads/distinct_256mb/vs_baseline"
+    assert mv[0]["direction"] == "regressed"
+    rc = obs_main(["trend", "--bench",
+                   str(tmp_path / "BENCH_r*.json")])
+    assert rc == 0
+    assert "distinct_256mb" in capsys.readouterr().out
+
+
+# --- prometheus export ------------------------------------------------------
+
+
+def test_sanitized_name_collision_guard():
+    entries = [("counter", "comms/a/b/bytes"), ("gauge", "comms/a_b/bytes"),
+               ("counter", "x+y"), ("counter", "x-y")]
+    names = sanitized_export_names(entries)
+    assert len(set(names.values())) == len(entries)
+    # deterministic: same input, same mapping
+    assert names == sanitized_export_names(list(reversed(entries)))
+    # the first taker (sorted) keeps the clean spelling
+    assert names[("counter", "comms/a/b/bytes")] == "moxt_comms_a_b_bytes"
+    assert names[("gauge", "comms/a_b/bytes")].startswith(
+        "moxt_comms_a_b_bytes_x")
+
+
+def test_prometheus_names_sticky_across_scrapes():
+    """A colliding key created AFTER a series was first exported must
+    not steal (or rename) the existing series — the mapping is sticky
+    for the registry's lifetime."""
+    reg = MetricsRegistry()
+    reg.count("comms/a_b/bytes", 5)          # sorts AFTER comms/a/b
+    first = prometheus_text(reg)
+    assert "moxt_comms_a_b_bytes 5" in first
+    reg.count("comms/a/b/bytes", 7)          # the would-be name thief
+    second = prometheus_text(reg)
+    assert "moxt_comms_a_b_bytes 5" in second     # original keeps it
+    assert "moxt_comms_a_b_bytes_x" in second     # newcomer suffixed
+    # and stays stable on every later scrape
+    assert prometheus_text(reg) == second
+
+
+_PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9.eE+inf-]+$")
+
+
+def _parse_prom(text: str) -> dict:
+    """Minimal Prometheus text-format parse check: every non-comment
+    line matches the exposition grammar; returns {series_name_with_
+    labels: value}."""
+    out = {}
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            continue
+        assert _PROM_LINE.match(line), f"bad exposition line: {line!r}"
+        key, val = line.rsplit(" ", 1)
+        out[key] = float(val) if val != "+Inf" else float("inf")
+    return out
+
+
+def test_prometheus_histogram_buckets_parse_and_cumulate():
+    reg = MetricsRegistry()
+    for v in (3.0, 30.0, 300.0, 3000.0, 10_000_000.0):
+        reg.observe("serve/queue_wait_ms", v, buckets=LATENCY_BUCKETS_MS)
+    text = prometheus_text(reg)
+    series = _parse_prom(text)
+    bucket_re = re.compile(
+        r'^moxt_serve_queue_wait_ms_hist_bucket\{le="([^"]+)"\}$')
+    buckets = []
+    for key, val in series.items():
+        m = bucket_re.match(key)
+        if m:
+            le = float("inf") if m.group(1) == "+Inf" else float(m.group(1))
+            buckets.append((le, val))
+    buckets.sort()
+    assert len(buckets) == len(LATENCY_BUCKETS_MS) + 1
+    # cumulative + monotone, +Inf == count, sum exact
+    counts = [c for _le, c in buckets]
+    assert counts == sorted(counts)
+    assert buckets[-1] == (float("inf"), 5.0)
+    assert buckets[0] == (5.0, 1.0)          # the 3ms observation
+    assert series["moxt_serve_queue_wait_ms_hist_count"] == 5.0
+    assert series["moxt_serve_queue_wait_ms_hist_sum"] == pytest.approx(
+        10_003_333.0)
+    # the summary quantiles still export beside the histogram
+    assert 'moxt_serve_queue_wait_ms{quantile="0.5"}' in series
+    # per-process labels compose with the le label
+    labeled = prometheus_text(reg, {"process": "1"})
+    assert 'le="+Inf",process="1"' in labeled
+
+
+# --- serve: per-job latency histograms --------------------------------------
+
+
+def _instant_runner(compiles=0):
+    def run(config, workload, on_obs):
+        obs = Obs.from_config(config)
+        on_obs(obs)
+        with obs.recording(config, workload):
+            pass
+        obs.finish(config, workload)
+
+        class _R:
+            metrics = {"records_in": 1,
+                       "compile/total_compiles": compiles}
+
+        return _R()
+
+    return run
+
+
+def test_scheduler_records_latency_histograms_and_warm_compiles(tmp_path):
+    from map_oxidize_tpu.serve.scheduler import Scheduler
+
+    corpus = _write_corpus(tmp_path / "c.txt", lines=5)
+    cfg = ServeConfig(spool_dir=str(tmp_path / "spool"), workers=1,
+                      job_sample_s=0.0, drain_timeout_s=5.0).validate()
+    sched = Scheduler(cfg, runner=_instant_runner(compiles=2))
+    reg = MetricsRegistry()
+    sched.server_registry = reg
+    sched.start()
+    try:
+        jobs = [sched.submit("wordcount", corpus) for _ in range(3)]
+        for j in jobs:
+            assert sched.wait(j.id, timeout=30).state == "done"
+    finally:
+        sched.shutdown()
+    with reg._lock:
+        hq = reg.histograms["serve/queue_wait_ms"]
+        ha = reg.histograms["serve/admission_wait_ms"]
+        hr = reg.histograms["serve/run_wall_ms"]
+    assert hq.count == ha.count == hr.count == 3
+    assert hq.buckets == tuple(LATENCY_BUCKETS_MS)
+    assert hq.cumulative_buckets()[-1] == (float("inf"), 3)
+    assert reg.counters["serve/jobs_total"] == 3
+    assert reg.counters["serve/jobs_done"] == 3
+    # warm-compile counter: job 1 is the cold compile (not counted);
+    # jobs 2-3 "recompiled" 2 programs each in this injected runner
+    assert reg.counters["serve/warm_compiles"] == 4
+    # the bucketed export parses as a real Prometheus histogram
+    series = _parse_prom(prometheus_text(reg))
+    assert series["moxt_serve_run_wall_ms_hist_count"] == 3.0
+    # /jobs rows carry the queue-wait evidence
+    row = sched.job_doc(jobs[0].id)
+    assert row["queue_wait_s"] >= 0
+
+
+# --- end-to-end: injected rule on a live job --------------------------------
+
+
+def test_injected_rule_fires_and_resolves_live(tmp_path):
+    """The acceptance path: an injected rule fires mid-run — visible at
+    /alerts, in the heartbeat output, in the obs top panel, and as an
+    incident bundle — then RESOLVES when the condition clears, and the
+    exported timeline carries both transitions."""
+    from map_oxidize_tpu.obs.cli import render_alerts
+
+    corpus = _write_corpus(tmp_path / "c.txt", lines=50)
+    rule = json.dumps({"defaults": False, "rules": [
+        {"name": "rows-floor", "metric": "progress/rows", "op": "<",
+         "threshold": 50, "kind": "value"}]})
+    cfg = JobConfig(input_path=corpus, output_path="",
+                    obs_port=0, obs_sample_s=0.02, slo_rules=rule,
+                    metrics_out=str(tmp_path / "metrics.json"),
+                    crash_dir=str(tmp_path / "crash")).validate()
+    obs = Obs.from_config(cfg)
+
+    def _get(ep):
+        return json.loads(urllib.request.urlopen(
+            f"{obs.server.url}{ep}", timeout=5).read())
+
+    deadline = time.monotonic() + 30
+    with obs.recording(cfg, "wordcount"):
+        doc = None
+        while time.monotonic() < deadline:   # rows=0 < 50: must fire
+            doc = _get("/alerts")
+            if doc["firing"]:
+                break
+            time.sleep(0.01)
+        assert doc["firing"] and doc["firing"][0]["rule"] == "rows-floor"
+        assert "rows-floor" in render_alerts(doc)
+        assert "/alerts" in _get("/")["endpoints"]
+        obs.heartbeat.update(rows=500)       # condition clears
+        while time.monotonic() < deadline:
+            doc = _get("/alerts")
+            if not doc["firing"] and doc["counts"]["resolved"]:
+                break
+            time.sleep(0.01)
+        assert not doc["firing"] and doc["counts"]["resolved"] == 1
+    obs.finish(cfg, "wordcount")
+    out = json.load(open(tmp_path / "metrics.json"))
+    events = [e["event"] for e in out["alerts"]["timeline"]]
+    assert events == ["fired", "resolved"]
+    assert out["counters"]["alerts/fired"] == 1
+    # incident bundle defaulted into the crash dir
+    assert any(d.startswith("incident_")
+               for d in os.listdir(tmp_path / "crash"))
+
+
+def test_default_rules_silent_on_healthy_run(tmp_path):
+    from map_oxidize_tpu.runtime import run_job
+
+    corpus = _write_corpus(tmp_path / "c.txt", lines=200)
+    cfg = JobConfig(input_path=corpus,
+                    output_path=str(tmp_path / "out.txt"),
+                    num_shards=1, num_chunks=4, obs_sample_s=0.01,
+                    metrics_out=str(tmp_path / "m.json")).validate()
+    run_job(cfg, "wordcount")
+    doc = json.load(open(tmp_path / "m.json"))
+    assert doc["alerts"]["counts"]["fired"] == 0
+    assert doc["alerts"]["timeline"] == []
+    assert "alerts/fired" not in doc["counters"]
+
+
+def test_crash_bundle_carries_alert_timeline(tmp_path):
+    """An abort mid-alert lands the firing state in the flight-recorder
+    bundle — which SLOs were red when the job died."""
+    corpus = _write_corpus(tmp_path / "c.txt", lines=5)
+    rule = json.dumps({"defaults": False, "rules": [
+        {"name": "always", "metric": "boom/level", "op": ">",
+         "threshold": 0}]})
+    cfg = JobConfig(input_path=corpus, output_path="",
+                    obs_sample_s=0.02, slo_rules=rule,
+                    crash_dir=str(tmp_path / "crash")).validate()
+    obs = Obs.from_config(cfg)
+    with pytest.raises(RuntimeError):
+        with obs.recording(cfg, "wordcount"):
+            obs.registry.set("boom/level", 9)
+            deadline = time.monotonic() + 20
+            while obs.alerts.fired_total == 0 \
+                    and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert obs.alerts.fired_total == 1
+            raise RuntimeError("abort with an alert firing")
+    bundles = [d for d in os.listdir(tmp_path / "crash")
+               if d.startswith("crash_")]
+    assert len(bundles) == 1
+    doc = json.load(open(tmp_path / "crash" / bundles[0]
+                         / "metrics.json"))
+    assert doc["alerts"]["counts"]["fired"] == 1
+    assert doc["alerts"]["firing"][0]["rule"] == "always"
