@@ -487,15 +487,18 @@ def _bench_ledger_entries(headline, workloads) -> list:
         if rate is None:
             continue
         metrics = {"rate": rate, "vs_baseline": e.get("vs_baseline")}
-        # XLA- and comms-layer gate fields ride along: a recompile, an
-        # MFU drop, unexplained comms-bytes growth, or a stall episode
-        # in a benchmarked workload fails --gate exactly like a rate
-        # drop (the comms bytes are deterministic accounting identities,
-        # so same-config entries compare exactly)
+        # XLA-, comms-, and spill-layer gate fields ride along: a
+        # recompile, an MFU drop, unexplained comms-bytes growth,
+        # unexplained spill growth, or a stall episode in a benchmarked
+        # workload fails --gate exactly like a rate drop (comms bytes
+        # and spill volumes are deterministic accounting identities, so
+        # same-config entries compare exactly)
         metrics.update({k: v for k, v in e.get("metrics_snapshot",
                                                {}).items()
                         if k.startswith(("compile/", "xprof/", "comms/",
-                                         "heartbeat/", "alerts/"))})
+                                         "heartbeat/", "alerts/",
+                                         "spill/", "demote/",
+                                         "shuffle/transport"))})
         entry = dict(base, workload=f"bench/{name}", metrics=metrics)
         if "ab_pairs" in e:
             # these entries switched measurement method (best-of ->
@@ -1301,7 +1304,121 @@ def _bench_workloads(run_job, JobConfig, probes=None) -> dict:
             out["serve_warm_small_jobs_error"] = entry["error"]
         else:
             out["serve_warm_small_jobs"] = entry
+
+    # --- spilled distributed shuffle (ISSUE-10): a 2-process inverted
+    # index forced past --collect-max-rows — the per-process disk
+    # transport must COMPLETE with oracle parity; spill volume rides the
+    # entry's metrics_snapshot, where the ledger's spill gate flags any
+    # later unexplained growth
+    _release_heap()
+    try:
+        entry = _bench_2proc_spill(slice_path)
+    except Exception as e:
+        out["inverted_index_2proc_spill_error"] = f"{type(e).__name__}: {e}"
+    else:
+        if "error" in entry:
+            out["inverted_index_2proc_spill_error"] = entry["error"]
+        else:
+            out["inverted_index_2proc_spill"] = entry
     return out
+
+
+def _bench_2proc_spill(corpus: str) -> dict:
+    """``inverted_index_2proc_spill``: 2 Gloo processes build the slice
+    corpus's inverted index with a resident-row cap far below the pair
+    count, so every pair crosses the mesh exchange and lands in
+    per-process disk buckets (--shuffle-transport auto routes to disk at
+    this corpus/cap ratio).  Detail entry, not a scoreboard row: it runs
+    on a forced CPU mesh (4 virtual devices per process — the same
+    DCN-path harness the tests use) so the wall measures the spill
+    machinery, comparable across rounds on the same host.  Parity: the
+    concatenated partition files must equal the single-process artifact
+    byte-for-byte after a line sort."""
+    import socket
+    import subprocess
+    import sys as _sys
+
+    from map_oxidize_tpu.config import JobConfig
+    from map_oxidize_tpu.runtime import run_job
+
+    cap_rows = 1 << 16
+    single_out = os.path.join(CACHE_DIR, "spill_single.txt")
+    run_job(JobConfig(input_path=corpus, output_path=single_out,
+                      backend="cpu", num_shards=1, metrics=False),
+            "invertedindex")
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = dict(os.environ)
+    for k in ("PALLAS_AXON_POOL_IPS", "PJRT_LIBRARY_PATH",
+              "TPU_LIBRARY_PATH", "PJRT_DEVICE", "TPU_ACCELERATOR_TYPE",
+              "TPU_TOPOLOGY", "TPU_WORKER_HOSTNAMES"):
+        env.pop(k, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    dist_out = os.path.join(CACHE_DIR, "spill_2proc.txt")
+    metrics_out = os.path.join(CACHE_DIR, "spill_2proc_metrics.json")
+    t0 = time.perf_counter()
+    procs = [subprocess.Popen(
+        [_sys.executable, "-m", "map_oxidize_tpu", "invertedindex", corpus,
+         "--output", dist_out, "--batch-size", str(1 << 16),
+         "--collect-max-rows", str(cap_rows), "--quiet",
+         "--dist-coordinator", f"127.0.0.1:{port}",
+         "--dist-processes", "2", "--dist-process-id", str(p),
+         "--metrics-out", metrics_out],
+        env=env, cwd=REPO, stdout=subprocess.DEVNULL,
+        stderr=subprocess.STDOUT) for p in range(2)]
+    try:
+        for p in procs:
+            p.wait(timeout=900)
+    except subprocess.TimeoutExpired:
+        # a lockstep wedge must not leak two spinning collective loops
+        # into the rest of the bench (they would tax every later entry)
+        for p in procs:
+            p.kill()
+        for p in procs:
+            p.wait()
+        return {"error": "2-proc spilled inverted index timed out "
+                         "(children killed)"}
+    secs = time.perf_counter() - t0
+    if any(p.returncode != 0 for p in procs):
+        return {"error": "2-proc spilled inverted index aborted "
+                         f"(rc={[p.returncode for p in procs]})"}
+    rows = []
+    for i in range(2):
+        with open(f"{dist_out}.part{i}of2", "rb") as f:
+            rows.extend(f.read().splitlines(keepends=True))
+    with open(single_out, "rb") as f:
+        single = b"".join(sorted(f.read().splitlines(keepends=True)))
+    if b"".join(sorted(rows)) != single:
+        return {"error": "2-proc spilled inverted index parity FAILED "
+                         "vs the single-process artifact"}
+    snaps = []
+    for i in range(2):
+        with open(f"{metrics_out}.proc{i}") as f:
+            doc = json.load(f)
+        snaps.append(dict(doc.get("counters", {}), **doc.get("gauges", {})))
+    spill_rows = sum(int(s.get("spill/rows", 0)) for s in snaps)
+    if spill_rows <= 0:
+        return {"error": "2-proc run past the cap never spilled"}
+    tokens = sum(int(s.get("records_in", 0)) for s in snaps)
+    keep = ("spill/", "demote/", "shuffle/", "compile/", "comms/",
+            "heartbeat/", "dist/")
+    snapshot = {k: v for k, v in snaps[0].items() if k.startswith(keep)}
+    snapshot["spill/rows_global"] = spill_rows
+    return {
+        "best_s": round(secs, 3),
+        "tokens_per_sec": round(tokens / secs, 1),
+        "collect_max_rows": cap_rows,
+        "transport": snaps[0].get("shuffle/transport"),
+        "spilled_rows_global": spill_rows,
+        "note": "2-process Gloo CPU-mesh inverted index forced past the "
+                "resident cap: per-process disk-bucket spill, oracle "
+                "parity enforced (detail entry; gate-watched via "
+                "metrics_snapshot spill counters)",
+        "metrics_snapshot": snapshot,
+    }
 
 
 def _bench_serve(corpus: str, n_jobs: int = 6) -> dict:

@@ -115,6 +115,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "(key,doc) pairs all spill; the sharded device "
                         "engine demotes to the host engine first); "
                         "0 = engine defaults")
+    from map_oxidize_tpu.shuffle.base import TRANSPORTS
+
+    p.add_argument("--shuffle-transport",
+                   choices=list(TRANSPORTS), default="auto",
+                   help="where collect-engine shuffle rows stage: hbm = "
+                        "strictly resident (the row cap is a hard error), "
+                        "disk = per-process top-bits disk buckets from the "
+                        "first row (bounded residency; distributed "
+                        "processes spill their disjoint hash partitions "
+                        "locally), hybrid = resident until the cap then "
+                        "demote to disk mid-job. auto routes on corpus "
+                        "size vs --collect-max-rows (estimated rows past "
+                        "the cap pick disk, else hybrid)")
     p.add_argument("--rescan-full", action="store_true",
                    help="hash-only mode: rescan the whole corpus when "
                         "resolving winner strings (extends the collision "
@@ -255,6 +268,7 @@ def config_from_args(args: argparse.Namespace) -> JobConfig:
         incident_dir=args.incident_dir,
         rescan_full=args.rescan_full,
         collect_max_rows=args.collect_max_rows,
+        shuffle_transport=args.shuffle_transport,
         hll_precision=args.hll_precision,
         kmeans_k=args.kmeans_k,
         kmeans_iters=args.kmeans_iters,

@@ -194,9 +194,23 @@ class JobConfig:
     #: hash-only counts, explicit (key, value) rows, and (key, doc) pairs
     #: all spill; the sharded device engine first demotes its HBM buffers
     #: to the host engine.  0 = engine defaults (host collect 2^28, pair
-    #: collect 2^27).  Multi-process pair collect still aborts at the cap
-    #: (cross-process demotion is not implemented).
+    #: collect 2^27).  What happens AT the cap is the shuffle transport's
+    #: call (``shuffle_transport``): hybrid demotes to disk buckets, disk
+    #: never stages residently in the first place, hbm aborts loudly.
     collect_max_rows: int = 0
+    #: shuffle transport for the collect engines (map_oxidize_tpu.shuffle):
+    #: where shuffled rows stage and what happens at the resident-row cap.
+    #: 'hbm' = strictly resident (device buffers / host RAM; the cap is a
+    #: hard error), 'disk' = per-process top-bits disk buckets from the
+    #: first row (bounded residency at any corpus size), 'hybrid' =
+    #: resident until the cap, then a one-way demotion to disk mid-job.
+    #: 'auto' routes on corpus size vs the cap: estimated rows
+    #: (corpus_bytes // 16) past collect_max_rows pick disk, else hybrid.
+    #: Applies to single-controller AND multi-process pair collect (each
+    #: distributed process spills its disjoint hash partition locally —
+    #: the old at-cap abort is gone); the fold engines bound DISTINCT
+    #: keys, not staged rows, and are unaffected.
+    shuffle_transport: str = "auto"
 
     def validate(self) -> "JobConfig":
         if self.tokenizer not in ("ascii", "unicode"):
@@ -238,6 +252,16 @@ class JobConfig:
                              f"got {self.kmeans_precision!r}")
         if self.collect_max_rows < 0:
             raise ValueError("collect_max_rows must be >= 0 (0 = default)")
+        from map_oxidize_tpu.shuffle.base import TRANSPORTS
+
+        if self.shuffle_transport not in TRANSPORTS:
+            raise ValueError(
+                f"shuffle_transport must be one of {'|'.join(TRANSPORTS)}, "
+                f"got {self.shuffle_transport!r}")
+        # disk + collect_sort='device' is rejected by the single-chip
+        # engine, not here: on a sharded mesh the combination is valid
+        # (collect_sort applies to the single-chip engine only) and only
+        # the engine knows which path the run resolves to
         if self.progress_interval_s <= 0:
             raise ValueError("progress_interval_s must be positive")
         if self.hbm_sample_s < 0:

@@ -218,6 +218,13 @@ def diff_entries(a: dict, b: dict, threshold_pct: float = 10.0,
     for name in sorted((set(ma) | set(mb)) - skip):
         va, vb = ma.get(name), mb.get(name)
         if not (isinstance(va, (int, float)) or isinstance(vb, (int, float))):
+            if name == "shuffle/transport" and va != vb:
+                # a transport flip under the same config hash (an auto-
+                # routing change) is the usual explanation for a spill
+                # gate hit — it must show in the diff rows, or the
+                # "unexplained spill growth" message sends the reader
+                # hunting for a demotion regression that isn't there
+                rows.append((name, va, vb, None))
             continue
         pct = _delta_pct(va, vb)
         if name in ("records_per_sec", "rate"):
@@ -277,6 +284,20 @@ def diff_entries(a: dict, b: dict, threshold_pct: float = 10.0,
             if isinstance(vb, (int, float)) and vb > va_n:
                 regressions.append(
                     f"{name}: {va_n:g} -> {vb:g} stall episodes")
+        elif name.startswith("spill/") and name.endswith(("rows", "bytes")):
+            # shuffle-transport gate: spill volume is deterministic for a
+            # fixed (workload, config, corpus) — the transport is config
+            # identity — so unexplained growth means rows started falling
+            # off the resident path (an admission-estimate or demotion
+            # regression); spill appearing from nothing flags too
+            if va != vb:
+                rows.append((name, va, vb, pct))
+            vb_n = vb if isinstance(vb, (int, float)) else 0
+            va_n = va if isinstance(va, (int, float)) else 0
+            if vb_n > va_n and (pct is None or pct > threshold_pct):
+                regressions.append(
+                    f"{name}: {va_n:,.0f} -> {vb_n:,.0f} "
+                    "(unexplained spill growth)")
         elif va != vb:
             rows.append((name, va, vb, pct))
     return {"rows": rows, "regressions": regressions, "warnings": warnings}

@@ -248,6 +248,13 @@ def build_status(obs, config, workload: str | None = None) -> dict:
         doc["counters"] = {
             k: v for k, v in obs.registry.counters.items()
             if k.startswith(("heartbeat/", "stall", "pipeline/"))}
+        # active shuffle transport + live spill/demotion evidence (the
+        # transport is a per-job fact — collect-engine jobs set it)
+        transport = obs.registry.gauges.get("shuffle/transport")
+        spill = {k: v for k, v in obs.registry.counters.items()
+                 if k.startswith(("spill/", "demote/"))}
+        if transport is not None or spill:
+            doc["shuffle"] = dict(spill, transport=transport)
     doc["comms"] = obs.registry.comms_table()
     # open span stacks (what the job is doing RIGHT NOW), when tracing
     if obs.tracer.enabled:

@@ -48,6 +48,33 @@ from map_oxidize_tpu.utils.logging import get_logger
 _log = get_logger(__name__)
 
 
+def raise_on_exchange_overflow(ovf) -> None:
+    """Abort loudly if an exchange dropped rows (one message, shared by
+    the resident append and the spilled-route paths)."""
+    dropped = int(np.asarray(ovf))
+    if dropped:
+        raise RuntimeError(
+            f"{dropped} rows dropped in the collect exchange: a "
+            "bucket overflowed bucket_cap; use the default safe "
+            "cap or raise it")
+
+
+def join_live_pairs(hi, lo, dhi, dlo):
+    """SENTINEL-mask one received plane block and join it to
+    ``(u64 keys, i64 docs)`` columns — THE plane-join every drain path
+    (host demotion, spilled routing, disk demotion) must share
+    bit-for-bit, or oracle parity breaks on exactly one of them.
+    Returns ``None`` when no live rows remain."""
+    sent = np.uint32(SENTINEL)
+    live = ~((hi == sent) & (lo == sent))
+    if not live.any():
+        return None
+    keys = (hi[live].astype(np.uint64) << np.uint64(32)) | lo[live]
+    docs = ((dhi[live].astype(np.uint64) << np.uint64(32))
+            | dlo[live]).view(np.int64)
+    return keys, docs
+
+
 class ShardedCollectEngine:
     """Append-only sharded collection of (key, doc) pairs; one sort per
     shard at finalize.  Host surface mirrors
@@ -56,6 +83,8 @@ class ShardedCollectEngine:
 
     def __init__(self, config: JobConfig, mesh=None, bucket_cap: int = 0,
                  max_rows: int = 1 << 27):
+        from map_oxidize_tpu.shuffle import make_transport, resolve_transport
+
         self.config = config
         self.mesh = mesh if mesh is not None else make_mesh(
             config.num_shards, config.backend)
@@ -67,8 +96,14 @@ class ShardedCollectEngine:
         #: rows one exchange hands each shard ([S source buckets] x cap)
         self.block = S * self.bucket_cap
         self.max_rows = max_rows
+        #: placement policy (map_oxidize_tpu.shuffle): hybrid = device
+        #: buffers until the cap then demote toward disk, disk = skip the
+        #: device entirely and stage in buckets from the first row, hbm =
+        #: strictly resident (the cap raises)
+        self.transport = resolve_transport(config, max_rows)
+        self._transport = make_transport(self.transport)
         self.rows_fed = 0
-        self.obs = None                # obs.Obs injected by the driver
+        self._obs = None               # obs.Obs injected by the driver
         self._stage: list = []
         self._staged = 0
         self._overflows: list = []     # replicated device scalars, one/flush
@@ -141,6 +176,39 @@ class ShardedCollectEngine:
             out_specs=(row2,) * 4,
         )))
 
+        if self.transport == "disk":
+            self._activate_disk_transport()
+
+    # observability: the bundle must reach whichever level currently
+    # stores rows — a disk-transport run owns a host engine from
+    # construction, before the driver injects obs
+    @property
+    def obs(self):
+        return self._obs
+
+    @obs.setter
+    def obs(self, value) -> None:
+        self._obs = value
+        if self._host is not None:
+            self._host.obs = value
+
+    def _activate_disk_transport(self) -> None:
+        """Disk transport on the single-controller sharded engine: rows
+        never stage in HBM at all — the host pair engine (whose own
+        transport resolves to ``disk``) buckets every feed from row 0.
+        The multi-process subclass overrides this with the per-process
+        spill (rows there must still cross the process boundary, so the
+        mesh exchange stays in the loop)."""
+        from map_oxidize_tpu.runtime.collect import CollectEngine
+
+        # sort_mode/transport pinned at construction: collect_sort=
+        # 'device' applies to the single-chip engine only, and the disk
+        # stage is host-sorted by definition
+        host = CollectEngine(self.config, max_rows=self.max_rows,
+                             sort_mode="host", transport="disk")
+        host.obs = self.obs
+        self._host = host
+
     # host-read hooks: the multi-process subclass must replicate sharded
     # values before np.asarray can address them (DistributedCollectEngine)
     def _cursor_max(self) -> int:
@@ -202,7 +270,9 @@ class ShardedCollectEngine:
             self._host.rows_fed = self.rows_fed - n  # its feed re-adds n
             self._host.feed(out)
             return
-        if self.rows_fed > self.max_rows:
+        if self._transport.admit(self.rows_fed, self.max_rows,
+                                 "sharded pair collect "
+                                 "(ShardedCollectEngine)") == "demote":
             self._demote_to_host()
             # the drained host engine was synced to rows_fed, which already
             # counts this block's n; its feed re-adds n, so back it out
@@ -225,6 +295,7 @@ class ShardedCollectEngine:
         STABLE key sort — so the drained compact blocks satisfy the host
         engine's ascending-doc invariant."""
         from map_oxidize_tpu.runtime.collect import CollectEngine
+        from map_oxidize_tpu.shuffle import record_demotion
 
         self.flush()
         self._check_exchange_overflows()
@@ -232,42 +303,33 @@ class ShardedCollectEngine:
             "sharded collect crossed max_rows=%d; demoting the %d-shard "
             "device buffers to the host engine (disk-bucket spill)",
             self.max_rows, self.S)
-        if self.obs is not None:
-            self.obs.registry.count("demote/events")
-            self.obs.registry.count("demote/rows", self.rows_fed)
-            self.obs.tracer.instant("collect/demote_to_host",
-                                    rows=self.rows_fed, shards=self.S,
-                                    max_rows=self.max_rows)
-        host = CollectEngine(self.config, max_rows=self.max_rows)
+        host = CollectEngine(self.config, max_rows=self.max_rows,
+                             sort_mode="host")  # target regardless of
         host.obs = self.obs  # the spill level keeps recording downstream
-        host.sort_mode = "host"  # demotion target regardless of collect_sort
-        host.device = None
-        if self._buf is not None:
-            s_hi, s_lo, s_dhi, s_dlo = [self._fetch(x) for x in self._buf]
-            sent = np.uint32(SENTINEL)
-            for s in range(self.S):
-                live = ~((s_hi[s] == sent) & (s_lo[s] == sent))
-                if not live.any():
-                    continue
-                keys = ((s_hi[s][live].astype(np.uint64) << np.uint64(32))
-                        | s_lo[s][live])
-                docs = ((s_dhi[s][live].astype(np.uint64) << np.uint64(32))
-                        | s_dlo[s][live]).view(np.int64)
-                host.feed(MapOutput(hi=None, lo=None, values=None,
-                                    records_in=0, keys64=keys, docs64=docs))
-            self._buf = None
-            self._cursor = None
+        # the host engine is the demotion TARGET: its own spill begin is
+        # part of this one transition, not a second demotion event
+        host._transport.spilled_state = True
+        with record_demotion(self.obs, self.rows_fed, "hbm", "disk",
+                             shards=self.S, max_rows=self.max_rows):
+            if self._buf is not None:
+                s_hi, s_lo, s_dhi, s_dlo = [self._fetch(x)
+                                            for x in self._buf]
+                for s in range(self.S):
+                    got = join_live_pairs(s_hi[s], s_lo[s], s_dhi[s],
+                                          s_dlo[s])
+                    if got is None:
+                        continue
+                    host.feed(MapOutput(hi=None, lo=None, values=None,
+                                        records_in=0, keys64=got[0],
+                                        docs64=got[1]))
+                self._buf = None
+                self._cursor = None
         host.rows_fed = self.rows_fed
         self._host = host
 
     def _check_exchange_overflows(self) -> None:
         for ovf in self._overflows:
-            dropped = int(np.asarray(ovf))
-            if dropped:
-                raise RuntimeError(
-                    f"{dropped} rows dropped in the collect exchange: a "
-                    "bucket overflowed bucket_cap; use the default safe "
-                    "cap or raise it")
+            raise_on_exchange_overflow(ovf)
         self._overflows = []
 
     def finalize_spilled_csr(self):
@@ -313,12 +375,14 @@ class ShardedCollectEngine:
             self._overflows.append(ovf)
             self._record_exchange(n, t0, ovf)
 
-    def _record_exchange(self, n: int, t0: float, ovf) -> None:
-        """Shuffle counters + comms-observatory row for one route_append
-        (shared with the multi-process subclass's lockstep feed).  Doc
-        planes ride as an 8-byte value row (dhi, dlo); latency is
-        sampled on the xprof cadence by forcing the tiny replicated
-        overflow scalar."""
+    def _record_exchange(self, n: int, t0: float, ovf,
+                         program: str = "collect/route_append") -> None:
+        """Shuffle counters + comms-observatory row for one exchange
+        (shared with the multi-process subclass's lockstep feed AND its
+        disk transport's route-to-spill exchange, which passes its own
+        ``program`` name).  Doc planes ride as an 8-byte value row
+        (dhi, dlo); latency is sampled on the xprof cadence by forcing
+        the tiny replicated overflow scalar."""
         if self.obs is None:
             return
         from map_oxidize_tpu.obs.metrics import sample_collective_wall
@@ -330,7 +394,7 @@ class ShardedCollectEngine:
         reg.count("shuffle/rows_exchanged", n)
         reg.count("shuffle/all_to_all_bytes", payload)
         lat_ms = sample_collective_wall(self, "_n_appends", t0, ovf)
-        reg.comm("all_to_all", "collect/route_append", payload,
+        reg.comm("all_to_all", program, payload,
                  shape=(self.S, self.bucket_cap), latency_ms=lat_ms)
 
     def finalize(self):

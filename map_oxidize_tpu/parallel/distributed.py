@@ -154,7 +154,7 @@ class DistributedReduceEngine:
         return self._eng.S
 
     def any_remaining(self, i_have_rows: bool) -> bool:
-        return _any_remaining(self, i_have_rows)
+        return _any_remaining(self, i_have_rows) > 0
 
     def merge_local(self, hi: np.ndarray, lo: np.ndarray,
                     vals: np.ndarray) -> None:
@@ -205,7 +205,23 @@ class DistributedCollectEngine(ShardedCollectEngineBase):
     XLA programs over a mesh whose devices span processes — and overrides
     the host surface: lockstep ``merge_local`` feeds assembled with
     ``make_array_from_process_local_data``; cursor/result reads replicate
-    first (sharded arrays are not fully addressable across processes)."""
+    first (sharded arrays are not fully addressable across processes).
+
+    Beyond-RAM: each process's post-exchange hash partition (the rows its
+    local mesh slice owns) is DISJOINT, so past ``max_rows`` the engine
+    spills it to private disk buckets (:mod:`map_oxidize_tpu.shuffle`)
+    instead of the old hard abort: the ``hybrid`` transport demotes the
+    device buffers mid-job, ``disk`` routes every exchanged block to the
+    buckets from round one, and ``hbm`` keeps a strict (now actionable)
+    cap.  The demotion trips on the lockstep-summed GLOBAL row count —
+    identical on every process by construction — so all processes switch
+    programs in the same round and the collective sequence stays
+    SPMD-consistent (``route_append`` before, ``route_spill`` after)."""
+
+    #: per-process disk-bucket stage (shuffle.disk.DiskPairStage); None
+    #: while rows stay device-resident
+    _disk = None
+    _spilled_rows_total = 0
 
     def __init__(self, config: JobConfig, mesh=None, **kw):
         import jax
@@ -228,6 +244,37 @@ class DistributedCollectEngine(ShardedCollectEngineBase):
         self._rep = jax.jit(lambda x: x,
                             out_shardings=replicated(self.mesh))
         self._flag_sum = _make_flag_sum(self.mesh)
+        #: lockstep-summed global rows (every process computes the same
+        #: value from the same psums) — what the demotion trips on
+        self._global_rows = 0
+        #: True once a rows-contributing flag round ran; guards against
+        #: a driver that feeds merge_local without ever syncing the
+        #: global count (the cap would silently stop existing)
+        self._rows_synced = False
+        self._route_spill_fn = None
+
+    def _activate_disk_transport(self) -> None:
+        """Per-process disk staging: rows still cross the process
+        boundary through the mesh exchange (that is the transport's wire
+        half), but each process drains the rows its local shards OWN into
+        private top-bits buckets instead of device buffers."""
+        import jax
+
+        from map_oxidize_tpu.shuffle import DiskPairStage
+
+        self._disk = DiskPairStage(
+            prefix=f"moxt_dist_spill_p{jax.process_index()}_",
+            obs=getattr(self, "_obs", None))
+
+    @property
+    def spilled(self) -> bool:
+        return self._disk is not None or self._spilled_rows_total > 0
+
+    @property
+    def spilled_rows(self) -> int:
+        if self._disk is not None:
+            return self._disk.rows
+        return self._spilled_rows_total
 
     def _cursor_max(self) -> int:
         return int(np.max(np.asarray(self._rep(self._cursor))))
@@ -235,14 +282,31 @@ class DistributedCollectEngine(ShardedCollectEngineBase):
     def _fetch(self, x) -> np.ndarray:
         return np.asarray(self._rep(x))
 
-    def any_remaining(self, i_have_rows: bool) -> bool:
-        return _any_remaining(self, i_have_rows)
+    @staticmethod
+    def _addressable_rows(arr) -> dict:
+        """{global shard row -> host block} for THIS process's slice of a
+        dim-0-sharded array — no collective, no replication (the whole
+        point of per-process spill)."""
+        return {sh.index[0].start: np.asarray(sh.data)
+                for sh in arr.addressable_shards}
+
+    def any_remaining(self, i_have_rows: bool, rows: "int | None" = None
+                      ) -> bool:
+        total = _any_remaining(self, i_have_rows, rows)
+        if rows is not None:
+            self._global_rows += total
+            self._rows_synced = True
+        return total > 0
 
     def merge_local(self, hi: np.ndarray, lo: np.ndarray,
                     vals: np.ndarray) -> None:
-        """One lockstep route+append; this process contributes up to
+        """One lockstep exchange round; this process contributes up to
         ``local_rows`` (term-hash, doc) pairs, SENTINEL-padded.  ``vals``
-        is the (n, 2) uint32 doc-plane pair the collect feed format uses."""
+        is the (n, 2) uint32 doc-plane pair the collect feed format uses.
+        Resident rounds append into the device buffers
+        (``route_append``); spilled rounds exchange into a fixed block
+        and drain each process's owned rows to its disk buckets
+        (``route_spill``)."""
         import jax
 
         n = hi.shape[0]
@@ -252,16 +316,25 @@ class DistributedCollectEngine(ShardedCollectEngineBase):
             raise ValueError(
                 "collect engines expect (n, 2) uint32 doc planes")
         self.rows_fed += n
-        if self.rows_fed > self.max_rows:
+        if (self._disk is None and not self._rows_synced
+                and self.rows_fed > self.max_rows):
+            # conservative backstop: local rows are a lower bound on the
+            # global count, so a driver that never syncs it (no
+            # any_remaining(..., rows=) rounds) still cannot grow the
+            # device buffers unboundedly past the cap
             raise RuntimeError(
-                f"DistributedCollectEngine exceeded max_rows="
-                f"{self.max_rows}: per-process spill is not yet "
-                "implemented, so the actionable escape hatches are to "
-                "shard wider (more processes, so each holds a smaller "
-                "hash partition) or raise --collect-max-rows if this "
-                "host's RAM allows it.  (Single-controller runs of the "
-                "same job spill to disk instead — dropping "
-                "--dist-coordinator trades wall-clock for completion.)")
+                "DistributedCollectEngine crossed max_rows="
+                f"{self.max_rows} but the global row count was never "
+                "synced: drive the engine through run_distributed_job, "
+                "or pass rows= to any_remaining each lockstep round so "
+                "the cap (and the disk demotion) can trip "
+                "SPMD-consistently")
+        if self._disk is None and self._transport.admit(
+                self._global_rows, self.max_rows,
+                "distributed pair collect (DistributedCollectEngine; "
+                "sharding wider — more processes — also shrinks each "
+                "process's partition)") == "demote":
+            self._demote_to_disk()
 
         def pad(a, fill=SENTINEL, dtype=np.uint32):
             p = np.full(self.local_rows, fill, dtype)
@@ -269,13 +342,16 @@ class DistributedCollectEngine(ShardedCollectEngineBase):
             return p
 
         planes = (pad(hi), pad(lo), pad(vals[:, 0]), pad(vals[:, 1]))
-        self._ensure_room()
         B = self.feed_batch
         batch = tuple(
             jax.make_array_from_process_local_data(self._row_spec, x, (B,))
             for x in planes)
         import time as _time
 
+        if self._disk is not None:
+            self._route_to_spill(batch, n)
+            return
+        self._ensure_room()
         t0 = _time.perf_counter()
         *state, ovf = self._route_append(*self._buf, self._cursor, *batch)
         self._buf = tuple(state[:4])
@@ -284,6 +360,134 @@ class DistributedCollectEngine(ShardedCollectEngineBase):
         self._cursor_ub += self.block
         self._overflows.append(ovf)
         self._record_exchange(n, t0, ovf)
+
+    def _make_route_spill(self):
+        """The spilled rounds' exchange program: route the global batch
+        to owner shards (the same ``_exchange`` the resident program
+        uses) and hand the received block straight back — no buffers, no
+        cursor, nothing device-resident survives the round."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from map_oxidize_tpu.obs.compile import observed_jit
+        from map_oxidize_tpu.parallel.mesh import SHARD_AXIS
+        from map_oxidize_tpu.parallel.shuffle import _exchange
+
+        S, cap = self.S, self.bucket_cap
+
+        def _route(hi, lo, dhi, dlo):
+            vals = jnp.stack([dhi, dlo], axis=1)
+            r_hi, r_lo, r_vals, ovf = _exchange(hi, lo, vals, S, cap)
+            return (r_hi[None], r_lo[None], r_vals[:, 0][None],
+                    r_vals[:, 1][None], ovf)
+
+        spec = P(SHARD_AXIS)
+        row2 = P(SHARD_AXIS, None)
+        return observed_jit("shuffle/route_spill", jax.jit(shard_map(
+            _route, mesh=self.mesh, in_specs=(spec,) * 4,
+            out_specs=(row2,) * 4 + (P(),))))
+
+    def _route_to_spill(self, batch, n: int) -> None:
+        import time as _time
+
+        from map_oxidize_tpu.parallel.collect import (
+            join_live_pairs,
+            raise_on_exchange_overflow,
+        )
+
+        if self._route_spill_fn is None:
+            self._route_spill_fn = self._make_route_spill()
+        t0 = _time.perf_counter()
+        r_hi, r_lo, r_dhi, r_dlo, ovf = self._route_spill_fn(*batch)
+        raise_on_exchange_overflow(ovf)
+        self._disk.obs = self.obs
+        hi_s = self._addressable_rows(r_hi)
+        lo_s = self._addressable_rows(r_lo)
+        dhi_s = self._addressable_rows(r_dhi)
+        dlo_s = self._addressable_rows(r_dlo)
+        staged = 0
+        for s, hblk in sorted(hi_s.items()):
+            got = join_live_pairs(hblk[0], lo_s[s][0], dhi_s[s][0],
+                                  dlo_s[s][0])
+            if got is None:
+                continue
+            staged += int(got[0].shape[0])
+            self._disk.add(*got)
+        if self.obs is not None and staged:
+            # bounded-residency evidence: host rows resident at once
+            self.obs.registry.gauge_max("shuffle/peak_staged_rows", staged)
+        self._record_exchange(n, t0, ovf, program="shuffle/route_spill")
+
+    def _demote_to_disk(self) -> None:
+        """The hybrid transport's RESIDENT -> SPILLED transition.  Every
+        process trips in the SAME lockstep round (the trip reads the
+        psum-summed ``_global_rows``), drains the rows its local mesh
+        slice owns from the device buffers into its private disk buckets
+        — a purely local read, the partitions are disjoint — and frees
+        the buffers.  Subsequent rounds run ``route_spill``."""
+        from map_oxidize_tpu.parallel.collect import join_live_pairs
+        from map_oxidize_tpu.shuffle import record_demotion
+
+        self._check_exchange_overflows()
+        _log.info(
+            "distributed collect crossed max_rows=%d globally; process "
+            "%d demotes its shard partition to per-process disk buckets",
+            self.max_rows, self.proc)
+        self._activate_disk_transport()
+        self._disk.obs = self.obs
+        with record_demotion(self.obs, self.rows_fed, "hbm", "disk",
+                             shards=self.S, processes=self.n_proc,
+                             max_rows=self.max_rows):
+            if self._buf is not None:
+                hi_s, lo_s, dhi_s, dlo_s = [self._addressable_rows(x)
+                                            for x in self._buf]
+                cur = self._addressable_rows(self._cursor)
+                for s, hblk in sorted(hi_s.items()):
+                    c = int(cur[s][0])
+                    if c <= 0:
+                        continue
+                    got = join_live_pairs(hblk[0][:c], lo_s[s][0][:c],
+                                          dhi_s[s][0][:c],
+                                          dlo_s[s][0][:c])
+                    if got is None:
+                        continue
+                    self._disk.add(*got)
+                self._buf = None
+                self._cursor = None
+                self._cursor_ub = 0
+
+    def finalize(self):
+        if self.spilled:
+            raise RuntimeError(
+                "per-process spill is active; use finalize_spilled_csr")
+        return super().finalize()
+
+    def finalize_spilled_csr(self):
+        """Bucket-by-bucket CSR finalize of THIS process's disk
+        partition (the shared
+        :meth:`~map_oxidize_tpu.shuffle.disk.DiskPairStage.drain_csr`).
+        The intra-bucket sort is the full (key, doc) lexsort: rows from
+        different processes' chunks interleave arbitrarily per term, so
+        the single-controller path's feed-order-stability argument does
+        not apply — and the lexsort restores oracle order exactly
+        because (term, doc) pairs are distinct by construction.  Terms
+        come out globally hash-ascending (buckets are top-bit ranges);
+        resident memory is one bucket at a time."""
+        if self._disk is None:
+            raise RuntimeError("engine did not spill; use finalize")
+        self._check_exchange_overflows()
+
+        def _sort_kd(keys, docs):
+            order = np.lexsort((docs, keys))
+            return keys[order], docs[order]
+
+        self._spilled_rows_total = self._disk.rows
+        terms, offsets, docs, holder, peak = self._disk.drain_csr(_sort_kd)
+        self._disk = None
+        if self.obs is not None and peak:
+            self.obs.registry.gauge_max("shuffle/peak_staged_rows", peak)
+        return terms, offsets, docs, holder
 
     def feed(self, out):  # pragma: no cover - contract guard
         raise NotImplementedError(
@@ -305,9 +509,17 @@ def _make_flag_sum(mesh):
         mesh=mesh, in_specs=P(SHARD_AXIS), out_specs=P())))
 
 
-def _any_remaining(engine, i_have_rows: bool) -> bool:
-    """Global OR over processes (one tiny mesh psum): does anyone still
-    have rows?  Every process must call this once per round.
+def _any_remaining(engine, i_have_rows: bool,
+                   rows: "int | None" = None) -> int:
+    """Global sum over processes (one tiny mesh psum): every process
+    must call this once per round; a positive sum means someone still
+    has rows.  With ``rows``, this process contributes its actual staged
+    row count for the coming round instead of a 0/1 flag — the SAME
+    compiled program on the same shapes, but the replicated sum is then
+    the GLOBAL rows entering the round, which is how every process
+    learns the lockstep-synchronized row count the collect engine's
+    disk demotion trips on (identical everywhere, so the transition is
+    SPMD-consistent).
 
     The round is host-synchronous (``np.asarray`` forces the psum), so
     its wall IS the collective's latency — recorded per invocation into
@@ -319,11 +531,16 @@ def _any_remaining(engine, i_have_rows: bool) -> bool:
     import jax
 
     S = engine.S
-    local = np.full(S // engine.n_proc, 1 if i_have_rows else 0, np.int32)
+    if rows is None:
+        local = np.full(S // engine.n_proc, 1 if i_have_rows else 0,
+                        np.int32)
+    else:
+        local = np.zeros(S // engine.n_proc, np.int32)
+        local[0] = int(rows) if i_have_rows else 0
     flags = jax.make_array_from_process_local_data(
         engine._sharding, local, (S,))
     t0 = _time.perf_counter()
-    out = int(np.asarray(engine._flag_sum(flags))) > 0
+    out = int(np.asarray(engine._flag_sum(flags)))
     obs = engine.obs
     if obs is not None:
         wall_ms = (_time.perf_counter() - t0) * 1e3
@@ -443,15 +660,17 @@ def _allgather_union(local: np.ndarray, obs=None) -> np.ndarray:
     return np.unique(np.concatenate(parts))
 
 
-def partition_strings(hashes, dictionary, proc: int, n_proc: int,
-                      obs=None) -> "dict[int, bytes]":
-    """Resolve key bytes for THIS process's hash partition
-    (``h % n_proc == proc``) of ``hashes``.  Local dictionary first; the
-    union of every process's misses resolves through one
-    :func:`gather_strings` round.  Every process must call this — it is a
-    collective — and every counted key was mapped by *some* process, so an
-    unresolvable key is an engine bug and raises."""
-    owned = [int(h) for h in hashes if int(h) % n_proc == proc]
+def resolve_strings_for(owned: "list[int]", dictionary,
+                        obs=None) -> "dict[int, bytes]":
+    """Resolve key bytes for an arbitrary DISJOINT partition of the key
+    space (each process passes the hashes it owns — by ``h % P`` on the
+    resident path, by owner shard on the spilled path).  Local
+    dictionary first; the union of every process's misses resolves
+    through one :func:`gather_strings` round.  Every process must call
+    this — it is a collective — and every counted key was mapped by
+    *some* process, so an unresolvable key is an engine bug and
+    raises."""
+    owned = [int(h) for h in owned]
     d = dictionary.materialized()
     missing = np.array([h for h in owned if h not in d], np.uint64)
     gathered = gather_strings(
@@ -467,6 +686,118 @@ def partition_strings(hashes, dictionary, proc: int, n_proc: int,
                 "dictionary should have recorded it")
         out[h] = b
     return out
+
+
+def partition_strings(hashes, dictionary, proc: int, n_proc: int,
+                      obs=None) -> "dict[int, bytes]":
+    """Resolve key bytes for THIS process's hash partition
+    (``h % n_proc == proc``) of ``hashes`` — the ``h % P`` spelling of
+    :func:`resolve_strings_for` (also a collective)."""
+    return resolve_strings_for(
+        [int(h) for h in hashes if int(h) % n_proc == proc],
+        dictionary, obs)
+
+
+def _allgather_u64(vals: np.ndarray, obs=None,
+                   program: str = "dist/spill_merge") -> np.ndarray:
+    """``process_allgather`` of a fixed-width u64 vector -> ``(P, k)``,
+    shipped as hi/lo uint32 planes (the x64-disabled downcast trap —
+    see :func:`_allgather_union`).  Every process must pass the same
+    ``k``."""
+    import time as _time
+
+    from jax.experimental import multihost_utils
+
+    from map_oxidize_tpu.ops.hashing import join_u64, split_u64
+
+    hi, lo = split_u64(np.asarray(vals, np.uint64))
+    planes = np.stack([hi, lo])
+    t0 = _time.perf_counter()
+    g = np.asarray(multihost_utils.process_allgather(planes))
+    if g.ndim == planes.ndim:
+        g = g[None]
+    if obs is not None:
+        P = g.shape[0]
+        obs.registry.comm("all_gather", program, P * P * planes.nbytes,
+                          shape=planes.shape,
+                          latency_ms=(_time.perf_counter() - t0) * 1e3)
+    return join_u64(g[:, 0], g[:, 1])
+
+
+def _allgather_i64(vals: np.ndarray, obs=None,
+                   program: str = "dist/spill_merge") -> np.ndarray:
+    """Signed twin of :func:`_allgather_u64` (two's-complement safe)."""
+    u = _allgather_u64(np.asarray(vals, np.int64).view(np.uint64), obs,
+                       program)
+    return u.view(np.int64)
+
+
+def _spilled_invertedindex_result(config: JobConfig, obs, engine,
+                                  dictionary, records: int,
+                                  flag_rounds: int, flag_s: float,
+                                  resumed: int) -> "DistributedResult":
+    """Finalize a spilled multi-process inverted index: each process
+    drains its private disk buckets into ITS partition's CSR (disjoint
+    by owner shard — no process ever materializes the global pair set,
+    which is the whole point), then the global facts reduce over tiny
+    collectives: term/pair totals and per-process top-k candidates
+    allgather (k rows per process, not the key space), winner strings
+    resolve through the usual miss-union gather, and each process
+    writes its partition file (``<output>.part<p>of<P>`` — partitioned
+    by owner shard here, not ``h % P``; the parts still cover the key
+    space disjointly, so concatenating them yields the same artifact)."""
+    from map_oxidize_tpu.io.writer import write_postings_stream
+
+    registry = obs.registry
+    P_ = engine.n_proc
+    with obs.phase("finalize"):
+        terms, offsets, docs, holder = engine.finalize_spilled_csr()
+        df = np.diff(offsets)
+        k = config.top_k
+        if terms.shape[0]:
+            order = np.lexsort((terms, -df))[:k]
+            cand_t, cand_df = terms[order], df[order]
+        else:
+            cand_t = np.empty(0, np.uint64)
+            cand_df = np.empty(0, np.int64)
+        # fixed-width candidate pads (df = -1 marks a pad row)
+        pad_t = np.zeros(k, np.uint64)
+        pad_df = np.full(k, -1, np.int64)
+        pad_t[:cand_t.shape[0]] = cand_t
+        pad_df[:cand_df.shape[0]] = cand_df
+        all_t = _allgather_u64(pad_t, obs).reshape(-1)
+        all_df = _allgather_i64(pad_df, obs).reshape(-1)
+        live = all_df >= 0
+        all_t, all_df = all_t[live], all_df[live]
+        # candidate partitions are disjoint, so the global top-k is a
+        # straight merge: df desc, hash asc on ties (engine convention)
+        sel = np.lexsort((all_t, -all_df))[:k]
+        t_hashes = [int(h) for h in all_t[sel]]
+        words = gather_strings(t_hashes, dictionary, obs)
+        top = [(h, words.get(h), int(c))
+               for h, c in zip(t_hashes, all_df[sel])]
+        totals = _allgather_i64(np.array(
+            [int(terms.shape[0]), int(offsets[-1])], np.int64), obs)
+        n_keys = int(totals[:, 0].sum())
+        n_pairs = int(totals[:, 1].sum())
+    if config.output_path:
+        with obs.phase("write"):
+            names = resolve_strings_for(terms.tolist(), dictionary, obs)
+            owned = sorted((names[int(h)], j)
+                           for j, h in enumerate(terms.tolist()))
+            # bucket drains already sorted each term's docs ascending
+            n_terms, n_bytes = write_postings_stream(
+                partition_output_path(config.output_path, engine.proc, P_),
+                ((term, docs[offsets[j]:offsets[j + 1]])
+                 for term, j in owned))
+        registry.count("dist/partition_terms_written", n_terms)
+        registry.count("dist/partition_bytes_written", n_bytes)
+    registry.set("spilled_pairs", int(engine.spilled_rows))
+    del holder  # the doc column was fully consumed by the writer
+    return DistributedResult(
+        counts=None, top=top, n_keys=n_keys, records=records,
+        n_pairs=n_pairs, flag_rounds=flag_rounds, flag_s=flag_s,
+        resumed_chunks=resumed)
 
 
 def partition_output_path(output_path: str, proc: int, n_proc: int) -> str:
@@ -585,6 +916,10 @@ def _run_distributed_core(config: JobConfig, workload: str, obs: Obs
     else:
         raise ValueError(f"unknown distributed workload {workload!r}")
     engine.obs = obs
+    if getattr(engine, "transport", None):
+        # the /status shuffle section + ledger entries name the active
+        # transport (collect engines only; fold engines have none)
+        registry.set("shuffle/transport", engine.transport)
     P_ = engine.n_proc
     dictionary = HashDictionary()
 
@@ -686,7 +1021,14 @@ def _run_distributed_core(config: JobConfig, workload: str, obs: Obs
             have = staged > 0
             t0 = _time.perf_counter()
             with obs.tracer.span("dist/lockstep_flag"):
-                cont = engine.any_remaining(have)
+                if doc_mode:
+                    # contribute the actual block size: the replicated
+                    # sum is then the GLOBAL rows entering this round —
+                    # the synchronized count the disk demotion trips on
+                    cont = engine.any_remaining(
+                        have, rows=min(staged, engine.local_rows))
+                else:
+                    cont = engine.any_remaining(have)
             flag_s += _time.perf_counter() - t0
             flag_rounds += 1
             if not cont:
@@ -696,7 +1038,11 @@ def _run_distributed_core(config: JobConfig, workload: str, obs: Obs
                                  rows=int(blk[0].shape[0])):
                 engine.merge_local(*blk)
 
-    if doc_mode:
+    if doc_mode and getattr(engine, "spilled", False):
+        result = _spilled_invertedindex_result(
+            config, obs, engine, dictionary, records=records,
+            flag_rounds=flag_rounds, flag_s=flag_s, resumed=resumed)
+    elif doc_mode:
         with obs.phase("finalize"):
             keys, docs = engine.finalize()
         # per-term doc counts from the sorted runs (term segments are

@@ -64,31 +64,58 @@ class CollectEngine:
       where the link is thousands of times faster; kept fully working and
       opt-in, same policy shape as the mapper's ``auto -> native``.
 
-    ``max_rows`` bounds RESIDENT memory: a host-mode job that crosses it
-    switches to an external-memory partition (top-bits disk buckets of
-    16-byte (key, doc) records — see ``_begin_spill``) instead of
-    aborting; finalize then streams one ~1/256th bucket at a time into a
-    CSR whose doc column is a disk memmap, so an index whose pairs exceed
-    RAM completes.  Device mode keeps the hard cap: HBM cannot spill
+    ``max_rows`` bounds RESIDENT memory; what happens at the cap is the
+    shuffle transport's policy (``config.shuffle_transport``,
+    :mod:`map_oxidize_tpu.shuffle`): ``hybrid`` (the ``auto`` default in
+    the resident regime) switches to an external-memory partition
+    (top-bits disk buckets of 16-byte (key, doc) records, staged through
+    :class:`~map_oxidize_tpu.shuffle.disk.DiskPairStage`) instead of
+    aborting, ``disk`` stages there from the FIRST row (bounded
+    residency, no demotion drain), and ``hbm`` aborts loudly.  A spilled
+    finalize streams one ~1/256th bucket at a time into a CSR whose doc
+    column is a disk memmap, so an index whose pairs exceed RAM
+    completes.  Device-sort mode keeps the hard cap: HBM cannot spill
     without becoming the host path."""
 
     #: disk-bucket count for the beyond-RAM path: top 8 key bits (the
     #: shared scheme — see runtime/spill.py for the partition rationale)
     SPILL_BUCKETS_BITS = 8
-    #: on-disk record: the joined u64 key + i64 doc id
-    SPILL_REC = np.dtype([("k", "<u8"), ("d", "<i8")])
 
     def __init__(self, config: JobConfig, device=None,
-                 max_rows: int = 1 << 27):
+                 max_rows: int = 1 << 27, sort_mode: str | None = None,
+                 transport: str | None = None):
+        from map_oxidize_tpu.shuffle import make_transport, resolve_transport
+
         self.config = config
-        self.sort_mode = ("host" if config.collect_sort == "auto"
-                          else config.collect_sort)
+        # callers that already made the placement decision (the sharded
+        # engine's demotion target / disk stage is always host-sorted)
+        # pin sort_mode/transport at construction instead of mutating
+        # afterward — the conflict handling below then only ever sees
+        # genuinely single-chip configurations
+        self.sort_mode = sort_mode if sort_mode is not None else (
+            "host" if config.collect_sort == "auto" else config.collect_sort)
         self.device = None
         if self.sort_mode == "device":
             self.device = device if device is not None else pick_device(
                 config.backend)
         self.feed_batch = config.batch_size
         self.max_rows = max_rows
+        self.transport = (transport if transport is not None
+                          else resolve_transport(config, max_rows))
+        if self.transport == "disk" and self.sort_mode == "device":
+            if config.shuffle_transport == "disk":
+                raise ValueError(
+                    "shuffle_transport='disk' stages rows in host disk "
+                    "buckets, which the single-chip collect_sort="
+                    "'device' (HBM-resident sort) cannot consume; use "
+                    "collect_sort host/auto")
+            # an AUTO-routed disk falls back to the resident policy the
+            # device sort can actually honor
+            _log.info("auto-routed shuffle_transport='disk' does not "
+                      "apply to collect_sort='device' (HBM cannot "
+                      "spill); keeping the resident path")
+            self.transport = "hybrid"
+        self._transport = make_transport(self.transport)
         self._batches: list = []   # device (4, B) blocks | host row tuples
         self._batch_rows: list[int] = []  # live rows per block
         self._stage: list = []
@@ -96,7 +123,7 @@ class CollectEngine:
         self.rows_fed = 0
         self.peak_staged_rows = 0           # observability + test oracle
         self.obs = None                     # obs.Obs injected by the driver
-        self._spill = None                  # runtime.spill.BucketFiles
+        self._spill = None                  # shuffle.disk.DiskPairStage
         self.spilled_rows = 0
 
     @property
@@ -127,99 +154,82 @@ class CollectEngine:
             # already spilling: route the fresh block straight to disk
             self._spill_pairs(*self._host_columns()[:2])
             return
-        if self.rows_fed > self.max_rows:
-            if self.sort_mode == "host":
-                self._begin_spill()
-            else:
-                raise RuntimeError(
-                    f"CollectEngine exceeded max_rows={self.max_rows} in "
-                    "device-sort mode (HBM cannot spill); re-run with "
-                    "--collect-sort host (collect_sort='host'), which "
-                    "spills to disk buckets past the cap, or raise "
-                    "--collect-max-rows if the rows genuinely fit")
+        if self.sort_mode == "host":
+            action = self._transport.admit(self.rows_fed, self.max_rows,
+                                           "pair collect (CollectEngine)")
+            if action != "resident":
+                # 'demote' and 'spill' converge here: _begin_spill drains
+                # whatever staged residently (nothing yet, for 'disk')
+                # into the buckets, then this and every later block
+                # spills on arrival
+                self._begin_spill(demote=action == "demote")
+        elif self.rows_fed > self.max_rows:
+            raise RuntimeError(
+                f"CollectEngine exceeded max_rows={self.max_rows} in "
+                "device-sort mode (HBM cannot spill); re-run with "
+                "--collect-sort host --shuffle-transport disk|hybrid "
+                "(collect_sort='host'), which stages past the cap in "
+                "disk buckets, or raise --collect-max-rows if the rows "
+                "genuinely fit")
         if self.sort_mode == "device" and self._staged >= self.feed_batch:
             self.flush()
 
     # --- external-memory partition (beyond-RAM pair jobs) ------------------
 
-    def _begin_spill(self) -> None:
-        """Switch to disk-bucket staging (the shared top-bits partition,
-        :mod:`runtime.spill`): 16B (key, doc) records; buckets are
-        top-bit ranges, so bucket-by-bucket finalize output concatenates
-        globally key-ascending.  The stable partition keeps feed order
-        within each bucket, preserving the per-term ascending-doc
-        invariant the stable finalize sort relies on."""
-        from map_oxidize_tpu.runtime.spill import BucketFiles
+    def _begin_spill(self, demote: bool = True) -> None:
+        """Switch to disk-bucket staging (the shared top-bits partition
+        via :class:`~map_oxidize_tpu.shuffle.disk.DiskPairStage`): 16B
+        (key, doc) records; buckets are top-bit ranges, so
+        bucket-by-bucket finalize output concatenates globally
+        key-ascending.  The stable partition keeps feed order within
+        each bucket, preserving the per-term ascending-doc invariant the
+        stable finalize sort relies on.  ``demote`` marks a mid-job
+        RESIDENT->SPILLED trip (hybrid at the cap) vs the disk
+        transport's from-row-0 staging — only the former records the
+        shared ``shuffle/demote`` evidence."""
+        import contextlib
 
-        self._spill = BucketFiles("moxt_pair_spill_",
-                                  self.SPILL_BUCKETS_BITS)
+        from map_oxidize_tpu.shuffle import DiskPairStage, record_demotion
+
+        self._spill = DiskPairStage(self.SPILL_BUCKETS_BITS,
+                                    "moxt_pair_spill_", obs=self.obs)
         _log.info(
-            "pair collect crossed max_rows=%d; spilling to %d disk "
-            "buckets under %s", self.max_rows,
+            "pair collect %s; staging in %d disk buckets under %s",
+            f"crossed max_rows={self.max_rows}" if demote
+            else "runs the disk transport",
             1 << self.SPILL_BUCKETS_BITS, self._spill.path)
-        if self.obs is not None:
-            self.obs.registry.count("spill/begin_events")
-            self.obs.tracer.instant("collect/spill_begin",
-                                    max_rows=self.max_rows,
-                                    rows_fed=self.rows_fed)
-        keys, docs, _owned = self._host_columns()
-        self._spill_pairs(keys, docs)
+        span = (record_demotion(self.obs, self._staged, "ram", "disk",
+                                max_rows=self.max_rows)
+                if demote else contextlib.nullcontext())
+        with span:
+            if self.obs is not None:
+                self.obs.registry.count("spill/begin_events")
+                self.obs.tracer.instant("collect/spill_begin",
+                                        max_rows=self.max_rows,
+                                        rows_fed=self.rows_fed)
+            keys, docs, _owned = self._host_columns()
+            self._spill_pairs(keys, docs)
 
     def _spill_pairs(self, keys: np.ndarray, docs: np.ndarray) -> None:
-        from map_oxidize_tpu.runtime.spill import partition_top_bits
-
-        order, counts, offs = partition_top_bits(
-            keys, self.SPILL_BUCKETS_BITS)
-        rec = np.empty(keys.shape[0], self.SPILL_REC)
-        rec["k"] = keys[order]
-        rec["d"] = docs[order]
-        self._spill.write_partitioned("kd", rec, counts, offs)
-        self.spilled_rows += int(keys.shape[0])
-        if self.obs is not None:
-            self.obs.registry.count("spill/rows", int(keys.shape[0]))
-            self.obs.registry.count("spill/bytes", int(rec.nbytes))
+        self._spill.add(keys, docs)
+        self.spilled_rows = self._spill.rows
 
     def finalize_spilled_csr(self):
-        """Bucket-by-bucket CSR finalize for spilled runs: each bucket is
-        loaded, stable-sorted by key, its doc segment appended to ONE
-        on-disk doc column, and its distinct terms/offsets accumulated.
-        Returns ``(terms, offsets, docs_memmap, holder)`` — terms are
-        globally hash-ascending (top-bit buckets), the doc column is a
-        read-only memmap, and ``holder`` is the temp directory keeping it
-        alive (attach it to whatever owns the result).  Resident memory:
-        the terms/offsets (distinct-sized) plus one bucket at a time."""
-        import os
-
+        """Bucket-by-bucket CSR finalize for spilled runs (the shared
+        :meth:`~map_oxidize_tpu.shuffle.disk.DiskPairStage.drain_csr`,
+        with the STABLE key sort — single-process feed order already
+        implies ascending docs per term).  Returns ``(terms, offsets,
+        docs_memmap, holder)`` — terms globally hash-ascending (top-bit
+        buckets), the doc column a read-only memmap, ``holder`` the temp
+        directory keeping it alive (attach it to whatever owns the
+        result).  Resident memory: terms/offsets plus one bucket at a
+        time."""
         if self._spill is None:
             raise RuntimeError("finalize_spilled_csr on an unspilled "
                                "engine; use finalize/finalize_csr")
-        terms_parts: list = []
-        df_parts: list = []
-        doc_path = os.path.join(self._spill.path, "docs.i64")
-        with open(doc_path, "wb") as out:
-            for i in range(1 << self.SPILL_BUCKETS_BITS):
-                rec = self._spill.take("kd", i, self.SPILL_REC)
-                if rec is None:
-                    continue
-                keys = np.ascontiguousarray(rec["k"])
-                docs = np.ascontiguousarray(rec["d"])
-                del rec
-                keys, docs = self._sorted_host_pairs(keys, docs)
-                bounds = (np.flatnonzero(np.concatenate(
-                    [[True], keys[1:] != keys[:-1]])) if keys.shape[0]
-                    else np.empty(0, np.int64))
-                terms_parts.append(keys[bounds])
-                df_parts.append(np.diff(np.append(bounds, keys.shape[0])))
-                out.write(docs.tobytes())
-        holder = self._spill.release()  # caller keeps the doc file alive
+        terms, offsets, docs, holder, _peak = self._spill.drain_csr(
+            self._sorted_host_pairs)
         self._spill = None
-        if not terms_parts:
-            return (np.empty(0, np.uint64), np.zeros(1, np.int64),
-                    np.empty(0, np.int64), holder)
-        terms = np.concatenate(terms_parts)
-        offsets = np.concatenate(
-            [[0], np.cumsum(np.concatenate(df_parts))]).astype(np.int64)
-        docs = np.memmap(doc_path, np.int64, mode="r")
         return terms, offsets, docs, holder
 
     def flush(self) -> None:

@@ -282,6 +282,9 @@ def _run_wordcount_body(config: JobConfig, obs: Obs, mapper: Mapper,
                          value_dtype=mapper.value_dtype,
                          wide_keys=getattr(mapper, "wide_keys", False))
     engine.obs = obs
+    if getattr(engine, "transport", None):
+        # collect engines carry a shuffle transport; fold engines don't
+        metrics.set("shuffle/transport", engine.transport)
 
     # hash-only map mode: with the host collect-reduce engine the map needs
     # neither per-chunk combining nor key strings (the one final sort dedups;
@@ -499,6 +502,8 @@ def _run_inverted_index_body(config: JobConfig, obs: Obs
 
         engine = CollectEngine(config, **collect_engine_kw(config))
     engine.obs = obs
+    # the active shuffle transport rides /status and the ledger entry
+    metrics.set("shuffle/transport", engine.transport)
     dictionary = HashDictionary()
     records_in = 0
     n_chunks = 0

@@ -536,7 +536,10 @@ class DeviceReduceEngine(StreamingEngineBase):
         if dropped:
             raise CapacityError(
                 f"{dropped} distinct keys dropped: accumulator exceeded "
-                f"key_capacity={self.max_capacity}; increase key_capacity"
+                f"key_capacity={self.max_capacity}; increase key_capacity "
+                "(--shuffle-transport does not apply here: the fold "
+                "accumulator bounds DISTINCT keys, not staged rows — "
+                "reduce_mode='collect' is the engine family that spills)"
             )
 
     def _finalize(self):
@@ -556,7 +559,11 @@ class DeviceReduceEngine(StreamingEngineBase):
             if dropped:
                 raise CapacityError(
                     f"{dropped} distinct keys dropped: accumulator exceeded "
-                    f"key_capacity={self.max_capacity}; increase key_capacity"
+                    f"key_capacity={self.max_capacity}; increase "
+                    "key_capacity (--shuffle-transport does not apply "
+                    "here: the fold accumulator bounds DISTINCT keys, not "
+                    "staged rows — reduce_mode='collect' is the engine "
+                    "family that spills)"
                 )
             return (packed[0, :-1], packed[1, :-1],
                     packed[2, :-1].view(self.value_dtype),

@@ -65,6 +65,8 @@ class HostCollectReduceEngine:
     def __init__(self, config: JobConfig, reducer: Reducer,
                  value_shape: tuple = (), value_dtype=np.int32,
                  max_rows: int = 1 << 28):
+        from map_oxidize_tpu.shuffle import make_transport, resolve_transport
+
         if tuple(value_shape) != ():
             raise ValueError("HostCollectReduceEngine takes scalar values; "
                              "use the fold engine for vector reduces")
@@ -74,6 +76,12 @@ class HostCollectReduceEngine:
         self.combine = reducer.combine
         self.value_dtype = np.dtype(value_dtype)
         self.max_rows = max_rows
+        #: placement policy (map_oxidize_tpu.shuffle): hybrid = today's
+        #: spill-past-the-cap, disk = buckets from the first row, hbm =
+        #: strictly resident (the cap raises)
+        self.transport = resolve_transport(config, max_rows)
+        self._transport = make_transport(self.transport)
+        self._buckets_opened: set = set()
         self.rows_fed = 0
         self._keys: list[np.ndarray] = []   # u64 blocks
         self._vals: list[np.ndarray] = []
@@ -118,39 +126,53 @@ class HostCollectReduceEngine:
         self._vals.append(vals)
         self._staged_rows += n
         self.peak_staged_rows = max(self.peak_staged_rows, self._staged_rows)
-        if self.rows_fed > self.max_rows:
-            self._begin_spill()
+        action = self._transport.admit(
+            self.rows_fed, self.max_rows,
+            "host collect-reduce (HostCollectReduceEngine)")
+        if action != "resident":
+            self._begin_spill(demote=action == "demote")
 
     def flush(self) -> None:  # feed is already host-resident
         pass
 
     # --- external-memory partition (beyond-RAM count jobs) ---------------
 
-    def _begin_spill(self) -> None:
+    def _begin_spill(self, demote: bool = True) -> None:
         """Switch to disk-bucket staging (the shared top-bits partition,
         :mod:`runtime.spill`): every staged block routes to per-bucket
         files, then all further feeds go the same way.  Resident memory
         drops to the per-feed block plus OS write buffers; finalize
         reduces one ~1/256th bucket at a time (buckets are top-bit
         ranges, so bucket-by-bucket output concatenates into the globally
-        ascending order every caller already expects)."""
+        ascending order every caller already expects).  ``demote`` marks
+        a mid-job trip at the cap (hybrid) vs the disk transport's
+        from-row-0 staging; only the former records the shared
+        ``shuffle/demote`` evidence."""
+        import contextlib
+
         from map_oxidize_tpu.runtime.spill import BucketFiles
+        from map_oxidize_tpu.shuffle import record_demotion
 
         self._spill = BucketFiles("moxt_spill_", self.SPILL_BUCKETS_BITS)
         _log.info(
-            "host collect crossed max_rows=%d; spilling to %d disk buckets "
-            "under %s", self.max_rows, 1 << self.SPILL_BUCKETS_BITS,
-            self._spill.path)
-        if self.obs is not None:
-            self.obs.registry.count("spill/begin_events")
-            self.obs.tracer.instant("host_reduce/spill_begin",
-                                    max_rows=self.max_rows,
-                                    rows_fed=self.rows_fed)
-        blocks, vals_list = self._keys, self._vals
-        self._keys = self._vals = None
-        self._staged_rows = 0
-        for k64, v in zip(blocks, vals_list):
-            self._spill_block(k64, v)
+            "host collect %s; staging in %d disk buckets under %s",
+            f"crossed max_rows={self.max_rows}" if demote
+            else "runs the disk transport",
+            1 << self.SPILL_BUCKETS_BITS, self._spill.path)
+        span = (record_demotion(self.obs, self._staged_rows, "ram", "disk",
+                                max_rows=self.max_rows)
+                if demote else contextlib.nullcontext())
+        with span:
+            if self.obs is not None:
+                self.obs.registry.count("spill/begin_events")
+                self.obs.tracer.instant("host_reduce/spill_begin",
+                                        max_rows=self.max_rows,
+                                        rows_fed=self.rows_fed)
+            blocks, vals_list = self._keys, self._vals
+            self._keys = self._vals = None
+            self._staged_rows = 0
+            for k64, v in zip(blocks, vals_list):
+                self._spill_block(k64, v)
 
     def _kv_dtype(self) -> np.dtype:
         return np.dtype([("k", "<u8"), ("v", self.value_dtype.str)])
@@ -181,9 +203,10 @@ class HostCollectReduceEngine:
             self._spill.write_partitioned("kv", rec, counts, offs)
             spilled_bytes = int(rec.nbytes)
         self.spilled_rows += int(k64.shape[0])
-        if self.obs is not None:
-            self.obs.registry.count("spill/rows", int(k64.shape[0]))
-            self.obs.registry.count("spill/bytes", spilled_bytes)
+        from map_oxidize_tpu.shuffle.disk import record_spill
+
+        record_spill(self.obs, self._buckets_opened, counts,
+                     int(k64.shape[0]), spilled_bytes)
 
     @staticmethod
     def _segment_bounds(keys_sorted: np.ndarray) -> np.ndarray:

@@ -1,0 +1,51 @@
+"""Pluggable shuffle transport layer.
+
+Exoshuffle's thesis (arXiv:2203.05072, PAPERS.md) is that shuffle belongs
+in an application-level library over swappable transports, not as a policy
+hard-wired into each engine.  This package is that seam for the collect
+engines: the *mechanisms* (the jitted ``all_to_all`` exchange programs,
+the top-bits disk-bucket partition) stay where they always lived
+(:mod:`map_oxidize_tpu.parallel.shuffle`, :mod:`map_oxidize_tpu.runtime.spill`);
+what moves here is the *policy* — where shuffled rows stage, when staging
+demotes to disk, and the observability contract every placement must
+honor — so the driver picks the transport (``--shuffle-transport``)
+instead of each engine hard-coding one.
+
+Three concrete transports behind one small interface:
+
+* :class:`~map_oxidize_tpu.shuffle.hbm.HbmTransport` — strictly
+  device/RAM-resident (today's ``all_to_all``/accumulator paths,
+  unchanged); crossing the resident-row cap is a hard, actionable error.
+* :class:`~map_oxidize_tpu.shuffle.disk.DiskTransport` — rows stage in
+  per-process top-bits disk buckets from the first row; bounded resident
+  memory at any corpus size.
+* :class:`~map_oxidize_tpu.shuffle.hybrid.HybridTransport` — resident
+  until the cap trips, then a one-way demotion to disk buckets mid-job.
+
+``auto`` routes on corpus size vs the cap (:func:`resolve_transport`).
+"""
+
+from map_oxidize_tpu.shuffle.base import (
+    AUTO_BYTES_PER_ROW,
+    ShuffleTransport,
+    TRANSPORTS,
+    make_transport,
+    record_demotion,
+    resolve_transport,
+)
+from map_oxidize_tpu.shuffle.disk import DiskPairStage, DiskTransport
+from map_oxidize_tpu.shuffle.hbm import HbmTransport
+from map_oxidize_tpu.shuffle.hybrid import HybridTransport
+
+__all__ = [
+    "AUTO_BYTES_PER_ROW",
+    "DiskPairStage",
+    "DiskTransport",
+    "HbmTransport",
+    "HybridTransport",
+    "ShuffleTransport",
+    "TRANSPORTS",
+    "make_transport",
+    "record_demotion",
+    "resolve_transport",
+]

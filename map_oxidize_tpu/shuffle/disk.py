@@ -1,0 +1,163 @@
+"""Disk transport: per-process beyond-RAM shuffle staging.
+
+Rows stage in the shared top-bits disk-bucket partition
+(:mod:`map_oxidize_tpu.runtime.spill`) from the FIRST row: resident
+memory stays bounded by one fed block plus OS write buffers at any
+corpus size, and the bucket-by-bucket drain at finalize yields the
+globally key-ascending order downstream consumers expect (buckets are
+top-bit key ranges).  Each distributed process spills only rows it OWNS
+— the hash partitions are disjoint, which is exactly why per-process
+spill is sound (ROADMAP open item 1).
+
+:class:`DiskPairStage` is the concrete (key, doc) pair stage shared by
+the single-controller pair collect (its beyond-RAM path now stages
+through it) and the distributed per-process spill — one code path, one
+record format, one obs contract."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from map_oxidize_tpu.shuffle.base import ShuffleTransport
+
+
+class DiskTransport(ShuffleTransport):
+    """SPILLED from the start: every block goes to disk buckets."""
+
+    name = "disk"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.spilled_state = True
+
+    def admit(self, resident_rows: int, max_rows: int, engine: str) -> str:
+        return "spill"
+
+
+def record_spill(obs, opened: set, counts: np.ndarray, rows: int,
+                 nbytes: int) -> None:
+    """The one spill-counter record — ``spill/rows``, ``spill/bytes``,
+    and ``spill/buckets`` (distinct bucket files opened, tracked through
+    the caller's ``opened`` set, which this mutates) — shared by every
+    bucket-staging engine so the ledger's spill gate always compares
+    like with like.  ``counts`` is the per-bucket row count of the block
+    just partitioned (``partition_top_bits``)."""
+    new = set(np.flatnonzero(counts).tolist()) - opened
+    opened |= new
+    if obs is not None:
+        reg = obs.registry
+        reg.count("spill/rows", rows)
+        reg.count("spill/bytes", nbytes)
+        if new:
+            reg.count("spill/buckets", len(new))
+
+
+class DiskPairStage:
+    """Top-bits disk-bucket staging of 16-byte (u64 key, i64 doc)
+    records — the one on-disk pair format.  Wraps
+    :class:`~map_oxidize_tpu.runtime.spill.BucketFiles` with the obs
+    contract (``spill/rows``, ``spill/bytes``, ``spill/buckets``) and
+    the record codec, so every spilling engine shares both.
+
+    The stable partition preserves feed order within a bucket; drain
+    callers choose the final intra-bucket sort (stable-by-key when feed
+    order already implies ascending docs, full (key, doc) lexsort when
+    rows interleave across processes)."""
+
+    #: on-disk record: the joined u64 key + i64 doc id
+    REC = np.dtype([("k", "<u8"), ("d", "<i8")])
+
+    def __init__(self, bits: int | None = None,
+                 prefix: str = "moxt_pair_spill_", obs=None):
+        from map_oxidize_tpu.runtime.spill import DEFAULT_BITS, BucketFiles
+
+        self.bits = DEFAULT_BITS if bits is None else bits
+        self.files = BucketFiles(prefix, self.bits)
+        self.obs = obs
+        self.rows = 0
+        self.bytes = 0
+        self._buckets_opened: set[int] = set()
+
+    @property
+    def n_buckets(self) -> int:
+        return 1 << self.bits
+
+    @property
+    def path(self) -> str:
+        return self.files.path
+
+    def add(self, keys: np.ndarray, docs: np.ndarray) -> None:
+        """Partition one (u64 keys, i64 docs) block by top key bits and
+        append to the bucket files, recording the spill counters."""
+        from map_oxidize_tpu.runtime.spill import partition_top_bits
+
+        n = int(keys.shape[0])
+        if n == 0:
+            return
+        order, counts, offs = partition_top_bits(
+            np.asarray(keys, np.uint64), self.bits)
+        rec = np.empty(n, self.REC)
+        rec["k"] = keys[order]
+        rec["d"] = docs[order]
+        self.files.write_partitioned("kd", rec, counts, offs)
+        self.rows += n
+        self.bytes += int(rec.nbytes)
+        record_spill(self.obs, self._buckets_opened, counts, n,
+                     int(rec.nbytes))
+
+    def take(self, i: int) -> "np.ndarray | None":
+        """Drain bucket ``i`` (read + unlink); None if never written."""
+        return self.files.take("kd", i, self.REC)
+
+    def drain_csr(self, sort_pairs):
+        """Bucket-by-bucket CSR finalize — THE shared drain (the
+        single-controller and distributed spilled finalizes differ only
+        in ``sort_pairs``, the intra-bucket ``(keys, docs) -> (keys,
+        docs)`` sort: stable-by-key where feed order already implies
+        ascending docs, full (key, doc) lexsort where rows interleave
+        across processes).  Each bucket loads, sorts, appends its doc
+        segment to ONE on-disk column, and accumulates distinct
+        terms/offsets; buckets are top-bit ranges, so terms come out
+        globally hash-ascending.  Returns ``(terms, offsets,
+        docs_memmap, holder, peak_rows)`` — ``holder`` keeps the doc
+        column alive, ``peak_rows`` is the largest bucket drained
+        (bounded-residency evidence).  Consumes the stage."""
+        import os
+
+        terms_parts: list = []
+        df_parts: list = []
+        doc_path = os.path.join(self.path, "docs.i64")
+        peak = 0
+        with open(doc_path, "wb") as out:
+            for i in range(self.n_buckets):
+                rec = self.take(i)
+                if rec is None:
+                    continue
+                keys = np.ascontiguousarray(rec["k"])
+                docs = np.ascontiguousarray(rec["d"])
+                del rec
+                peak = max(peak, int(keys.shape[0]))
+                keys, docs = sort_pairs(keys, docs)
+                bounds = (np.flatnonzero(np.concatenate(
+                    [[True], keys[1:] != keys[:-1]])) if keys.shape[0]
+                    else np.empty(0, np.int64))
+                terms_parts.append(keys[bounds])
+                df_parts.append(np.diff(np.append(bounds, keys.shape[0])))
+                out.write(docs.tobytes())
+        holder = self.release()  # caller keeps the doc file alive
+        if not terms_parts:
+            return (np.empty(0, np.uint64), np.zeros(1, np.int64),
+                    np.empty(0, np.int64), holder, peak)
+        terms = np.concatenate(terms_parts)
+        offsets = np.concatenate(
+            [[0], np.cumsum(np.concatenate(df_parts))]).astype(np.int64)
+        docs = np.memmap(doc_path, np.int64, mode="r")
+        return terms, offsets, docs, holder, peak
+
+    def release(self):
+        """Hand the temp directory to the caller (keeps on-disk finalize
+        artifacts like the CSR doc column alive)."""
+        return self.files.release()
+
+    def cleanup(self) -> None:
+        self.files.cleanup()

@@ -1,0 +1,31 @@
+"""HBM transport: strictly resident shuffle staging.
+
+This is today's default mechanism given a name: rows live in the device
+accumulators / host RAM stage, cross-shard routing is the jitted
+``all_to_all`` exchange (:func:`map_oxidize_tpu.parallel.shuffle._exchange`
+and the engines' ``route_append`` programs built on it), and the payload
+accounting identity is :func:`map_oxidize_tpu.parallel.shuffle.exchange_payload_bytes`
+— none of which this class re-implements; the engines keep owning their
+compiled programs (zero behavior change on the resident path, and the
+``comms/*/bytes`` ledger gate keeps proving it).
+
+What ``hbm`` adds is the *strict* placement contract: the resident row
+cap is a hard error, never a silent demotion — the right default for
+latency-pinned serving jobs where a surprise disk drain mid-job is worse
+than an up-front rejection.  The error names the escape hatches
+(``--shuffle-transport disk|hybrid``)."""
+
+from __future__ import annotations
+
+from map_oxidize_tpu.shuffle.base import ShuffleTransport
+
+
+class HbmTransport(ShuffleTransport):
+    """RESIDENT-only: never trips to disk; the cap raises."""
+
+    name = "hbm"
+
+    def admit(self, resident_rows: int, max_rows: int, engine: str) -> str:
+        if resident_rows > max_rows:
+            raise self.cap_error(resident_rows, max_rows, engine)
+        return "resident"

@@ -1,0 +1,34 @@
+"""Hybrid transport: resident speed, disk safety net.
+
+Starts on the HBM/RAM-resident path and makes the one-way
+RESIDENT -> SPILLED transition when the resident row count crosses the
+cap — the engine drains its resident state into disk buckets (under a
+``shuffle/demote`` span, :func:`map_oxidize_tpu.shuffle.base.record_demotion`)
+and stages every later block there.  This names the demotion ladder the
+single-controller engines already climb (device buffers -> host engine
+-> disk buckets) and extends it to the distributed pair collect, whose
+old behavior at the cap was a hard abort ("per-process spill is not yet
+implemented" — dead as of this transport).
+
+Demotion trips on a count every participant agrees on: the distributed
+engine feeds it the lockstep-summed GLOBAL row count (identical on every
+process by construction), so all processes demote in the same round and
+the collective program sequence stays SPMD-consistent."""
+
+from __future__ import annotations
+
+from map_oxidize_tpu.shuffle.base import ShuffleTransport
+
+
+class HybridTransport(ShuffleTransport):
+    """RESIDENT until the cap trips, then SPILLED for good."""
+
+    name = "hybrid"
+
+    def admit(self, resident_rows: int, max_rows: int, engine: str) -> str:
+        if self.spilled_state:
+            return "spill"
+        if resident_rows > max_rows:
+            self.spilled_state = True
+            return "demote"
+        return "resident"

@@ -74,6 +74,87 @@ python -m map_oxidize_tpu obs diff --ledger-dir "$smoke/ledger" \
 # report is what a gate failure gets attributed with)
 python -m map_oxidize_tpu obs trend --ledger-dir "$smoke/ledger" | head -8
 
+echo "== spilled shuffle smoke =="
+# a 2-process inverted index forced far past --collect-max-rows: the old
+# "per-process spill is not yet implemented" abort is gone — the job
+# must COMPLETE (auto routes the transport to per-process disk buckets
+# at this corpus/cap ratio), its concatenated partition files must match
+# the single-process artifact, and spill/rows must be nonzero on every
+# process; the default resident path on the same corpus must spill
+# NOTHING (the zero-spill assertion)
+python - "$smoke" <<'EOF'
+import sys
+import numpy as np
+rng = np.random.default_rng(7)
+words = [b"alpha", b"beta", b"gamma", b"delta", b"eps", b"zeta",
+         b"eta", b"theta", b"iota", b"kappa"]
+with open(f"{sys.argv[1]}/corpus_spill.txt", "wb") as f:
+    for _ in range(40000):
+        f.write(b" ".join(words[int(i)]
+                          for i in rng.integers(0, 10, 8)) + b"\n")
+EOF
+JAX_PLATFORMS=cpu python -m map_oxidize_tpu invertedindex \
+    "$smoke/corpus_spill.txt" --output "$smoke/spill_single.txt" \
+    --num-shards 1 --quiet \
+    --metrics-out "$smoke/spill_default_metrics.json" > /dev/null
+spill_port=$(python - <<'EOF'
+import socket
+s = socket.socket(); s.bind(("127.0.0.1", 0))
+print(s.getsockname()[1]); s.close()
+EOF
+)
+spill_pids=()
+for p in 0 1; do
+    # timeout guard: a lockstep wedge must kill BOTH spinning collective
+    # loops, not hang the whole check (same guard bench.py's twin uses)
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=4 \
+        timeout -k 10 600 \
+        python -m map_oxidize_tpu invertedindex "$smoke/corpus_spill.txt" \
+        --output "$smoke/spill_2proc.txt" --batch-size 65536 \
+        --collect-max-rows 4096 --quiet \
+        --dist-coordinator "127.0.0.1:$spill_port" --dist-processes 2 \
+        --dist-process-id "$p" \
+        --metrics-out "$smoke/spill_metrics.json" > /dev/null &
+    spill_pids+=($!)
+done
+spill_rc=0
+for pid in "${spill_pids[@]}"; do wait "$pid" || spill_rc=$?; done
+if [ "$spill_rc" -ne 0 ]; then
+    # both children are reaped (the loop waits on every pid before this
+    # check), so a failure cannot orphan the sibling inside a collective
+    echo "spilled shuffle smoke: a 2-proc child failed (rc=$spill_rc)"
+    exit "$spill_rc"
+fi
+python - "$smoke" <<'EOF'
+import json, sys
+d = sys.argv[1]
+# parity: concatenated partition files == the single-process artifact
+rows = []
+for i in range(2):
+    rows.extend(open(f"{d}/spill_2proc.txt.part{i}of2",
+                     "rb").read().splitlines(keepends=True))
+single = b"".join(sorted(open(f"{d}/spill_single.txt",
+                              "rb").read().splitlines(keepends=True)))
+assert b"".join(sorted(rows)) == single, "spilled 2-proc output != single"
+spilled = 0
+for i in range(2):
+    m = json.load(open(f"{d}/spill_metrics.json.proc{i}"))
+    assert m["gauges"]["shuffle/transport"] == "disk", \
+        f"auto should route this corpus/cap ratio to disk: {m['gauges']}"
+    r = m["counters"].get("spill/rows", 0)
+    assert r > 0, f"process {i} never spilled"
+    assert m["counters"].get("spill/buckets", 0) >= 1
+    spilled += r
+# the default resident path on the same corpus must spill NOTHING
+dm = json.load(open(f"{d}/spill_default_metrics.json"))
+assert dm["gauges"]["shuffle/transport"] == "hybrid"
+assert "spill/rows" not in dm["counters"], dm["counters"]
+assert "demote/events" not in dm["counters"], dm["counters"]
+print(f"spilled shuffle OK: 2-proc completed past the cap "
+      f"({spilled} rows through per-process disk buckets), "
+      "parity exact, default path zero-spill")
+EOF
+
 echo "== dispatch-floor smoke =="
 # scan-batched streamed k-means: a center-seeded corpus streams through
 # the device in 5 chunks/iteration at --dispatch-batch 4 (one full block

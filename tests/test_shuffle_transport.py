@@ -1,0 +1,344 @@
+"""Pluggable shuffle transports (map_oxidize_tpu.shuffle).
+
+The transport is a swappable placement policy behind one driver flag
+(--shuffle-transport), so the load-bearing claims are parity claims:
+
+* the same 8-virtual-device job under ``hbm`` and ``disk`` produces
+  byte-identical output, and the hbm run's comms accounting still obeys
+  the exchange-payload identity (the refactor changed nothing resident);
+* ``hybrid`` demotes mid-job with the shared ``shuffle/demote`` span and
+  ``spill/*`` counters, and its output still matches;
+* a 2-process Gloo inverted index with a tiny ``--collect-max-rows``
+  COMPLETES (the old "per-process spill is not yet implemented" abort is
+  gone) with oracle-exact postings, disjoint per-process spill volumes
+  that sum to the global pair count, and bounded host staging.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from map_oxidize_tpu.config import JobConfig
+from map_oxidize_tpu.runtime import run_job
+
+import test_distributed as td
+
+
+def _corpus(tmp_path, lines=1200):
+    path = tmp_path / "c.txt"
+    td._write_corpus(path, lines=lines)
+    return path
+
+
+# --- routing + spelling ----------------------------------------------------
+
+
+def test_resolve_transport_routes_on_corpus_size(tmp_path):
+    from map_oxidize_tpu.shuffle import AUTO_BYTES_PER_ROW, resolve_transport
+
+    path = tmp_path / "r.txt"
+    path.write_bytes(b"x" * 4096)
+    cfg = JobConfig(input_path=str(path))
+    est = 4096 // AUTO_BYTES_PER_ROW
+    # estimated rows past the cap -> disk (skip the demotion drain)
+    assert resolve_transport(cfg, est - 1) == "disk"
+    # resident regime -> hybrid (today's engine behavior, named)
+    assert resolve_transport(cfg, est + 1) == "hybrid"
+    # explicit pins win regardless of size
+    for name in ("hbm", "disk", "hybrid"):
+        cfg2 = JobConfig(input_path=str(path), shuffle_transport=name)
+        assert resolve_transport(cfg2, 1) == name
+    # unreadable input (serve jobs validate later): safe hybrid default
+    assert resolve_transport(JobConfig(input_path="/no/such"), 1) == "hybrid"
+
+
+def test_config_and_cli_spelling(tmp_path):
+    with pytest.raises(ValueError, match="shuffle_transport"):
+        JobConfig(shuffle_transport="ssd").validate()
+    # disk + device sort is rejected by the SINGLE-CHIP engine (the only
+    # path where the combination is genuinely impossible), not by config
+    # validation — on a sharded mesh collect_sort applies to the
+    # single-chip engine only and the pinned disk transport is valid
+    from map_oxidize_tpu.runtime.collect import CollectEngine
+
+    cfg = JobConfig(shuffle_transport="disk", collect_sort="device")
+    cfg.validate()
+    with pytest.raises(ValueError, match="disk buckets"):
+        CollectEngine(cfg)
+    from map_oxidize_tpu.cli import build_parser, config_from_args
+
+    path = tmp_path / "c.txt"
+    path.write_bytes(b"a b c\n")
+    args = build_parser().parse_args(
+        ["invertedindex", str(path), "--shuffle-transport", "disk"])
+    assert config_from_args(args).shuffle_transport == "disk"
+    # serve --set rides the same JobConfig field (string passthrough)
+    from map_oxidize_tpu.serve.client import coerce_overrides
+
+    assert coerce_overrides(["shuffle_transport=hybrid"]) == {
+        "shuffle_transport": "hybrid"}
+
+
+def test_transport_state_machines():
+    from map_oxidize_tpu.shuffle import make_transport
+
+    hbm = make_transport("hbm")
+    assert hbm.admit(10, 100, "t") == "resident"
+    with pytest.raises(RuntimeError, match="--shuffle-transport disk"):
+        hbm.admit(101, 100, "t")
+    disk = make_transport("disk")
+    assert disk.admit(1, 100, "t") == "spill"
+    hybrid = make_transport("hybrid")
+    assert hybrid.admit(10, 100, "t") == "resident"
+    assert hybrid.admit(101, 100, "t") == "demote"   # the one-way trip
+    assert hybrid.admit(102, 100, "t") == "spill"    # never demotes twice
+    with pytest.raises(ValueError, match="unknown shuffle transport"):
+        make_transport("ssd")
+
+
+# --- single-controller parity (the 8-virtual-device mesh) ------------------
+
+
+def _run_ii(corpus, out, transport, max_rows=0, shards=0, trace=False):
+    cfg = JobConfig(input_path=str(corpus), output_path=str(out),
+                    backend="cpu", num_shards=shards, metrics=False,
+                    chunk_bytes=4096, batch_size=1 << 12,
+                    shuffle_transport=transport,
+                    collect_max_rows=max_rows,
+                    trace_out="-" if trace else None)
+    return run_job(cfg, "invertedindex")
+
+
+def test_sharded_hbm_vs_disk_byte_identical(tmp_path):
+    """Transport swap parity on the 8-device mesh: identical output
+    bytes, identical postings facts — and the hbm run's comms accounting
+    still satisfies the exchange-payload identity while the disk run
+    moves ZERO collective bytes (it never stages in HBM)."""
+    corpus = _corpus(tmp_path)
+    r_hbm = _run_ii(corpus, tmp_path / "hbm.txt", "hbm")
+    r_disk = _run_ii(corpus, tmp_path / "disk.txt", "disk")
+    assert ((tmp_path / "hbm.txt").read_bytes()
+            == (tmp_path / "disk.txt").read_bytes())
+    for key in ("pairs", "distinct_terms"):
+        assert r_hbm.metrics[key] == r_disk.metrics[key]
+    assert r_hbm.metrics["shuffle/transport"] == "hbm"
+    assert r_disk.metrics["shuffle/transport"] == "disk"
+    # hbm: resident path untouched — comms identity intact, no spill
+    from map_oxidize_tpu.parallel.shuffle import exchange_payload_bytes
+
+    exchanges = r_hbm.metrics["shuffle/exchanges"]
+    S = r_hbm.metrics["comms/all_to_all/collect/route_append/calls"]
+    assert S == exchanges
+    per = r_hbm.metrics["shuffle/all_to_all_bytes"] / exchanges
+    # the per-exchange payload is the accounting identity for SOME
+    # (S, cap): reconstruct from the engine's default sizing on 8 shards
+    cap = (1 << 12) // 8
+    assert per == exchange_payload_bytes(8, cap, 8)
+    assert (r_hbm.metrics["comms/all_to_all/collect/route_append/bytes"]
+            == r_hbm.metrics["shuffle/all_to_all_bytes"])
+    assert "spill/rows" not in r_hbm.metrics
+    # disk: every pair spilled, nothing exchanged
+    assert r_disk.metrics["spill/rows"] == r_disk.metrics["pairs"]
+    assert r_disk.metrics["spill/buckets"] >= 1
+    assert r_disk.metrics["spilled_pairs"] == r_disk.metrics["pairs"]
+    assert not any(k.startswith("comms/all_to_all/")
+                   for k in r_disk.metrics)
+
+
+def test_hybrid_demotes_with_shared_span(tmp_path):
+    """The mid-job RESIDENT->SPILLED trip on the sharded engine records
+    the shared evidence — one shuffle/demote span, demote/* and spill/*
+    counters — and the output still matches the resident run."""
+    corpus = _corpus(tmp_path)
+    r_big = _run_ii(corpus, tmp_path / "big.txt", "hybrid")
+    r = _run_ii(corpus, tmp_path / "hyb.txt", "hybrid", max_rows=2000,
+                trace=True)
+    assert ((tmp_path / "big.txt").read_bytes()
+            == (tmp_path / "hyb.txt").read_bytes())
+    assert r.metrics["demote/events"] == 1
+    assert r.metrics["demote/rows"] > 0
+    assert r.metrics["spill/rows"] > 0
+    assert r.metrics["spill/buckets"] >= 1
+    spans = [e for e in r.trace
+             if e.get("ph") == "X" and e.get("name") == "shuffle/demote"]
+    assert len(spans) == 1, "expected exactly one shuffle/demote span"
+    assert spans[0]["args"]["rows"] > 0
+
+
+def test_single_chip_disk_bounds_staging(tmp_path):
+    """num_shards=1 (plain CollectEngine): the disk transport spills
+    from the FIRST row, so peak host staging stays at one feed block
+    while the resident run stages every pair."""
+    from map_oxidize_tpu.runtime.collect import CollectEngine
+
+    corpus = _corpus(tmp_path)
+    engines = {}
+    orig = CollectEngine.feed
+
+    def spy(self, out):
+        engines[self.transport] = self
+        return orig(self, out)
+
+    CollectEngine.feed = spy
+    try:
+        r_disk = _run_ii(corpus, tmp_path / "d1.txt", "disk", shards=1)
+        r_res = _run_ii(corpus, tmp_path / "r1.txt", "hybrid", shards=1)
+    finally:
+        CollectEngine.feed = orig
+    assert ((tmp_path / "d1.txt").read_bytes()
+            == (tmp_path / "r1.txt").read_bytes())
+    pairs = r_res.metrics["pairs"]
+    assert engines["hybrid"].peak_staged_rows == pairs
+    assert 0 < engines["disk"].peak_staged_rows < pairs
+    assert r_disk.metrics["spill/rows"] == pairs
+
+
+def test_sharded_auto_disk_survives_device_sort_config(tmp_path):
+    """collect_sort='device' applies to the single-chip engine only; on
+    the sharded path an auto-routed disk transport must still stage on
+    disk from row 0 (review finding: the nested host engine used to
+    silently degrade to hybrid before its sort_mode was forced to
+    host — demoting mid-job while the gauge claimed 'disk')."""
+    corpus = _corpus(tmp_path)
+    for transport in ("auto", "disk"):  # auto: est rows >> 100 -> disk
+        cfg = JobConfig(input_path=str(corpus),
+                        output_path=str(tmp_path / f"o_{transport}.txt"),
+                        backend="cpu", num_shards=0, metrics=False,
+                        chunk_bytes=4096, batch_size=1 << 12,
+                        collect_sort="device", collect_max_rows=100,
+                        shuffle_transport=transport)
+        r = run_job(cfg, "invertedindex")
+        assert r.metrics["shuffle/transport"] == "disk"
+        assert r.metrics["spill/rows"] == r.metrics["pairs"]
+        assert "demote/events" not in r.metrics  # from row 0, no demotion
+
+
+def test_hbm_cap_message_names_the_transports(tmp_path):
+    corpus = _corpus(tmp_path)
+    with pytest.raises(RuntimeError,
+                       match=r"--shuffle-transport disk.*hybrid"):
+        _run_ii(corpus, tmp_path / "x.txt", "hbm", max_rows=500)
+    with pytest.raises(RuntimeError, match="disk"):
+        _run_ii(corpus, tmp_path / "y.txt", "hbm", max_rows=500, shards=1)
+
+
+# --- multi-process: the old cap-abort is dead ------------------------------
+
+_CHILD = r"""
+import json, sys
+pid = int(sys.argv[1]); nproc = int(sys.argv[2]); port = sys.argv[3]
+corpus = sys.argv[4]; out_path = sys.argv[5]
+transport = sys.argv[6]; cap = int(sys.argv[7]); final = sys.argv[8]
+from map_oxidize_tpu.config import JobConfig
+from map_oxidize_tpu.parallel.distributed import (
+    init_distributed, run_distributed_job)
+init_distributed(f"127.0.0.1:{port}", num_processes=nproc, process_id=pid)
+cfg = JobConfig(input_path=corpus, output_path=final, chunk_bytes=4096,
+                batch_size=1 << 12, key_capacity=1 << 12, top_k=5,
+                metrics=False, collect_max_rows=cap,
+                shuffle_transport=transport)
+r = run_distributed_job(cfg, "invertedindex")
+m = r.metrics or {}
+json.dump({
+    "n_keys": r.n_keys, "n_pairs": r.n_pairs, "records": r.records,
+    "top": [[f"{h:#018x}", None if w is None else w.decode(), c]
+            for h, w, c in r.top],
+    "spill_rows": m.get("spill/rows", 0),
+    "demotes": m.get("demote/events", 0),
+    "peak_staged": m.get("shuffle/peak_staged_rows", 0),
+    "transport": m.get("shuffle/transport"),
+}, open(out_path, "w"), sort_keys=True)
+print("child", pid, "ok")
+"""
+
+
+def _launch_spill(tmp_path, corpus, transport, cap, tag):
+    env = td._env(4)
+    final = str(tmp_path / f"ii_{tag}.txt")
+    outs = [str(tmp_path / f"out_{tag}_{i}.json") for i in range(2)]
+    for attempt in range(2):
+        port = td._free_port()
+        procs = [subprocess.Popen(
+            [sys.executable, "-c", _CHILD, str(i), "2", str(port),
+             str(corpus), outs[i], transport, str(cap), final],
+            env=env, cwd=td.REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True) for i in range(2)]
+        logs = []
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=420)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                out = "(timeout)"
+            logs.append(out)
+        if all(p.returncode == 0 for p in procs):
+            break
+        if attempt == 1:
+            for i, p in enumerate(procs):
+                assert p.returncode == 0, f"process {i} failed:\n{logs[i]}"
+    results = [json.load(open(p)) for p in outs]
+    parts = sorted(tmp_path.glob(f"ii_{tag}.txt.part*"))
+    assert len(parts) == 2
+    rows = []
+    for p in parts:
+        rows.extend(p.read_bytes().splitlines(keepends=True))
+    return results, b"".join(sorted(rows))
+
+
+def test_two_process_spilled_invertedindex_oracle(tmp_path):
+    """The acceptance scenario: a 2-process inverted index with a tiny
+    --collect-max-rows COMPLETES under both beyond-RAM transports with
+    oracle-exact postings, byte-identical concatenated partition output
+    vs the single-process artifact, disjoint per-process spill summing
+    to the global pair count, and bounded host staging."""
+    # 1500 lines -> ~5.9k pairs: more than one lockstep exchange round at
+    # batch_size 4096, so per-round staging is a strict fraction of each
+    # process's partition (the bounded-staging assertion below)
+    corpus = _corpus(tmp_path, lines=1500)
+    from map_oxidize_tpu.workloads.inverted_index import (
+        inverted_index_model,
+    )
+
+    model = inverted_index_model(str(corpus))
+    n_pairs = sum(len(d) for d in model.values())
+    want_dfs = sorted((len(d) for d in model.values()), reverse=True)[:5]
+
+    run_job(JobConfig(input_path=str(corpus),
+                      output_path=str(tmp_path / "single.txt"),
+                      backend="cpu", num_shards=1, metrics=False,
+                      chunk_bytes=4096), "invertedindex")
+    single = b"".join(sorted(
+        (tmp_path / "single.txt").read_bytes().splitlines(keepends=True)))
+
+    for transport, cap in (("disk", 1500), ("hybrid", 1500)):
+        results, merged = _launch_spill(tmp_path, corpus, transport, cap,
+                                        transport)
+        assert merged == single, f"{transport}: output parity failed"
+        spill = [r.pop("spill_rows") for r in results]
+        peaks = [r.pop("peak_staged") for r in results]
+        records = [r.pop("records") for r in results]
+        demotes = [r.pop("demotes") for r in results]
+        assert results[0] == results[1]
+        r = results[0]
+        assert r["transport"] == transport
+        assert r["n_keys"] == len(model)
+        assert r["n_pairs"] == n_pairs
+        assert [c for _h, _w, c in r["top"]] == want_dfs
+        for _h, w, c in r["top"]:
+            assert w is not None and len(model[w.encode()]) == c
+        # per-process partitions are disjoint and cover every pair
+        assert all(s > 0 for s in spill)
+        assert sum(spill) == n_pairs
+        assert sum(records) == sum(
+            1 for _ in open(corpus, "rb").read().split())
+        # bounded staging: no process ever held its partition whole
+        assert all(0 < p < s for p, s in zip(peaks, spill))
+        if transport == "hybrid":
+            assert demotes == [1, 1]   # one synchronized trip each
+        else:
+            assert demotes == [0, 0]   # disk never demotes
