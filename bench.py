@@ -610,7 +610,8 @@ def _metrics_snapshot(result) -> dict:
                              "shuffle/", "engine/", "mem/", "pipeline/",
                              "feed_block_ms/", "compile/", "xprof/",
                              "device/", "hbm/", "comms/", "heartbeat/",
-                             "dispatch/", "alerts/"))}
+                             "dispatch/", "alerts/", "attrib/",
+                             "profile/", "calib/"))}
     return snap
 
 

@@ -222,6 +222,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="where SLO incident bundles land (series window "
                         "+ status snapshot per alert firing); default: "
                         "the --crash-dir, if any")
+    p.add_argument("--profile-dir", default=None,
+                   help="where on-demand POST /profile deep captures "
+                        "land (jax.profiler device trace + host "
+                        "sampling stacks); default: next to the crash "
+                        "bundles / metrics document")
+    p.add_argument("--host-sample-hz", type=float, default=50.0,
+                   help="host sampling profiler rate during a /profile "
+                        "capture (Python stacks per second)")
+    p.add_argument("--calib-dir", default=None,
+                   help="persistent calibration store: accumulate this "
+                        "run's measured collective bytes/latency and "
+                        "per-program dispatch/compute into "
+                        "<dir>/calib.json (merged atomically across "
+                        "runs; render with `obs calib`)")
     p.add_argument("--keep-intermediates", action="store_true")
     p.add_argument("-v", "--verbose", action="store_true")
     p.add_argument("-q", "--quiet", action="store_true")
@@ -266,6 +280,9 @@ def config_from_args(args: argparse.Namespace) -> JobConfig:
         obs_sample_s=args.obs_sample_interval,
         slo_rules=args.slo_rules,
         incident_dir=args.incident_dir,
+        profile_dir=args.profile_dir,
+        host_sample_hz=args.host_sample_hz,
+        calib_dir=args.calib_dir,
         rescan_full=args.rescan_full,
         collect_max_rows=args.collect_max_rows,
         shuffle_transport=args.shuffle_transport,

@@ -156,6 +156,23 @@ class JobConfig:
     #: where alert incident bundles land (series window + /status
     #: snapshot per firing); None = the --crash-dir, if any
     incident_dir: str | None = None
+    #: deep-profiling plane (obs/profiler.py): where on-demand
+    #: ``POST /profile`` captures land (device trace + host sampling
+    #: stacks + profile.json).  None = next to the crash bundles /
+    #: metrics document, else ./moxt-profiles
+    profile_dir: str | None = None
+    #: host sampling profiler rate for ``POST /profile`` captures:
+    #: Python thread stacks snapshotted this many times per second
+    #: (sys._current_frames; overhead is one frame walk per thread per
+    #: tick, only WHILE a capture runs)
+    host_sample_hz: float = 50.0
+    #: persistent calibration store (obs/calib.py): directory whose
+    #: ``calib.json`` accumulates measured per-(platform, devices,
+    #: topology, collective, program, shape-bucket) bytes/latency and
+    #: per-program dispatch/compute figures ACROSS runs — loaded at job
+    #: start, merged atomically at finish, rendered by ``obs calib``.
+    #: None disables
+    calib_dir: str | None = None
     #: multi-host: coordination-service address ("host:port"); empty = the
     #: single-process path.  With it set, dist_num_processes and
     #: dist_process_id select this process's slot; jax.distributed is
@@ -279,6 +296,10 @@ class JobConfig:
                 "use a lower port or 0 (ephemeral)")
         if self.obs_sample_s < 0:
             raise ValueError("obs_sample_s must be >= 0 (0 = off)")
+        if not 0 < self.host_sample_hz <= 1000:
+            raise ValueError(
+                "host_sample_hz must be in (0, 1000] samples/sec, got "
+                f"{self.host_sample_hz}")
         if self.slo_rules:
             from map_oxidize_tpu.obs.slo import load_rules
 
@@ -354,6 +375,11 @@ class ServeConfig:
     #: per-job silent-heartbeat/series cadence (gives every job's /jobs
     #: row live rows/sec without --progress); 0 disables
     job_sample_s: float = 0.5
+    #: persistent calibration store shared by every job the server runs
+    #: (measured collective bytes/latency + program dispatch/compute
+    #: accumulated across jobs AND server restarts — the warm-figures
+    #: substrate); empty = ``<spool>/calib``; "none" disables
+    calib_dir: str = ""
     #: terminal-job retention: /jobs lists at most this many finished/
     #: rejected jobs; older ones are dropped from memory (their spool
     #: artifacts remain on disk) so a resident process stays bounded

@@ -153,6 +153,16 @@ class Obs:
     #: job body so the flight recorder runs
     cancel_event: threading.Event = field(default_factory=threading.Event)
     cancel_reason: "str | None" = None
+    #: calibration store hookup (obs/calib.py): ``calib`` accumulates
+    #: THIS run's measurements (seeded empty; merged into the store
+    #: file at finish), ``calib_prior`` is the loaded cross-run history
+    #: consumers read (collective chooser, auto-B).  Both None without
+    #: ``--calib-dir`` — or when the store on disk refused to load
+    calib: "object | None" = None
+    calib_prior: "object | None" = None
+    #: first-phase latch: Obs.phase stamps ``attrib/setup_ms`` (wall
+    #: from Obs creation to the first phase span) exactly once
+    _setup_stamped: bool = False
 
     @classmethod
     def from_config(cls, config, process: int = 0,
@@ -262,6 +272,26 @@ class Obs:
             obs.server = ObsServer(
                 obs, config, serve_port_for_process(obs_port, process))
             obs.server.start()
+        calib_dir = getattr(config, "calib_dir", None)
+        if calib_dir:
+            from map_oxidize_tpu.obs import calib as _calib
+
+            path = os.path.join(calib_dir, _calib.CALIB_FILE)
+            try:
+                # prior history loads for consumers (collective chooser,
+                # auto-B warm figures); the RUN accumulator is a fresh
+                # empty store so the finish-time merge never double-
+                # counts the history already on disk
+                obs.calib_prior = _calib.CalibStore.load(path)
+                obs.calib = _calib.CalibStore(path=path)
+            except _calib.CalibMismatch as e:
+                # refusal is the contract: stale/torn evidence must not
+                # merge — the run proceeds uncalibrated, loudly
+                obs.registry.set("calib/load_refused", 1)
+                from map_oxidize_tpu.utils.logging import get_logger
+
+                get_logger(__name__).warning(
+                    "calibration store refused to load: %s", e)
         return obs
 
     def request_cancel(self, reason: str = "cancelled") -> None:
@@ -289,6 +319,19 @@ class Obs:
         peaks: finalize fetches, sort buffers, write staging).  Also a
         cancellation point (:meth:`poll_cancel`)."""
         self.poll_cancel()
+        if not self._setup_stamped:
+            # the attribution ledger's ``setup`` bucket source: Obs
+            # creation to the first phase span (config/engine/backend
+            # bring-up).  Deliberately NOT named attrib/setup_ms — the
+            # published bucket gauge owns that name, and a shared name
+            # would feed the published value back into the next compute
+            self._setup_stamped = True
+            import time as _time
+
+            self.registry.set(
+                "attrib/pre_phase_ms",
+                round(max(_time.time() - self.tracer.wall_start, 0.0)
+                      * 1e3, 3))
         if self.heartbeat is not None:
             self.heartbeat.set_phase(name)
         prev, self.current_phase = self.current_phase, name
@@ -362,6 +405,34 @@ class Obs:
             self.registry.set(k, v)
         return report
 
+    def _merge_calibration(self, xprof_report: dict | None) -> None:
+        """Fold this run's comms table + xprof program rows into the
+        persistent calibration store and merge it atomically into the
+        store file (obs/calib.py).  A refusal (schema/identity mismatch
+        on disk) records ``calib/merge_refused`` and moves on — the
+        job's own result is never hostage to the store."""
+        if self.calib is None:
+            return
+        from map_oxidize_tpu.obs import calib as _calib
+        from map_oxidize_tpu.utils.logging import get_logger
+
+        try:
+            ident = _calib.run_identity(self.n_processes)
+            touched = self.calib.accumulate_run(
+                ident, self.registry.comms_table(), xprof_report)
+            if touched:
+                self.calib.save_merged()
+                self.registry.set("calib/rows_merged", touched)
+                self.registry.set(
+                    "calib/runs", self.calib.doc.get("runs", 0))
+        except _calib.CalibMismatch as e:
+            self.registry.set("calib/merge_refused", 1)
+            get_logger(__name__).warning(
+                "calibration store refused the merge: %s", e)
+        except Exception as e:  # pragma: no cover - the store is
+            # evidence, never a reason to fail a finished job
+            get_logger(__name__).warning("calibration merge failed: %s", e)
+
     def finish(self, config, workload: str | None = None
                ) -> tuple[dict, list | None]:
         """End-of-job hook: final memory watermarks, the xprof export,
@@ -371,6 +442,17 @@ class Obs:
         off."""
         self.stop_live()
         xprof_report = self.finish_xprof()
+        # the end-of-job wall attribution: buckets + unattributed
+        # remainder as attrib/* gauges (ledger/gate/BENCH_DETAIL) and
+        # the structured section the metrics document carries
+        import time as _time
+
+        from map_oxidize_tpu.obs import attrib as _attrib
+
+        attrib_doc = _attrib.finalize(
+            self, xprof_report,
+            max(_time.time() - self.tracer.wall_start, 1e-9))
+        self._merge_calibration(xprof_report)
         sample_host_memory(self.registry)
         sample_device_memory(self.registry)
         if self.heartbeat is not None:
@@ -378,6 +460,7 @@ class Obs:
         meta = self.stamp(config, workload)
         if config.metrics_out:
             doc = dict(self.registry.to_dict(), meta=meta)
+            doc["attrib"] = attrib_doc
             if xprof_report is not None:
                 doc["xprof"] = xprof_report
             if self.series is not None:

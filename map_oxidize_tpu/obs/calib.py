@@ -1,0 +1,375 @@
+"""Persistent cross-run calibration store: measured collective and
+program costs that SURVIVE the process.
+
+ROADMAP item 3 (portable collectives, arXiv:2112.01075) picks collective
+decompositions from *measured* per-(collective, program, shape)
+bytes/latency tables, and the auto dispatch-batch roofline wants warm
+per-program dispatch/compute figures on a COLD process — but until this
+module every measurement died with the job: the comms observatory and
+the compile ledger are in-memory.  The calibration store accumulates
+them across runs:
+
+* one versioned JSON document (``<calib_dir>/calib.json``,
+  ``moxt-calib-v1``) holding **comms rows** keyed
+  ``(platform, device-count, topology, collective, program,
+  shape-bucket)`` — calls, payload bytes, sampled latency mass — and
+  **program rows** keyed ``(platform, device-count, topology, program)``
+  — dispatches, dispatch wall, sampled device compute, compiles;
+* shape-bucket is the power-of-two floor of the per-call payload
+  (``"1MB"`` covers [1MB, 2MB)): close payloads share a row, so curves
+  accumulate density instead of exploding per exact shape;
+* loaded at ``Obs.from_config`` (``obs.calib``), accumulated from the
+  job's comms table + xprof report at ``Obs.finish``, and **merged
+  atomically** into the store file: the merge re-reads the file under an
+  ``flock`` and writes temp+rename, so concurrent finishing processes
+  (a 2-process job, a resident server's workers) interleave safely;
+* merges REFUSE mismatches instead of corrupting evidence: an unknown
+  schema/version refuses wholesale, and a row whose key disagrees with
+  its stored identity fields (a doctored or torn store) refuses too —
+  ``calib/merge_refused`` lands as a gauge either way.
+
+``obs calib`` renders the store as per-collective bandwidth curves —
+the measurement substrate ROADMAP items 2 and 3 consume.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+from map_oxidize_tpu.utils.logging import get_logger
+
+_log = get_logger(__name__)
+
+CALIB_SCHEMA = "moxt-calib-v1"
+CALIB_VERSION = 1
+CALIB_FILE = "calib.json"
+
+#: identity fields every row carries (and its key encodes)
+_COMM_IDENTITY = ("platform", "device_count", "topology", "collective",
+                  "program", "shape_bucket")
+_PROG_IDENTITY = ("platform", "device_count", "topology", "program")
+
+
+class CalibMismatch(ValueError):
+    """The store (or a merge source) is not compatible: wrong schema/
+    version, or a row's key disagrees with its identity fields."""
+
+
+def shape_bucket(nbytes_per_call: float) -> str:
+    """Power-of-two payload bucket label: ``"64KB"`` = [64KB, 128KB)."""
+    n = int(nbytes_per_call)
+    if n <= 0:
+        return "0B"
+    k = n.bit_length() - 1
+    floor = 1 << k
+    for scale, suffix in ((1 << 40, "TB"), (1 << 30, "GB"),
+                          (1 << 20, "MB"), (1 << 10, "KB")):
+        if floor >= scale:
+            return f"{floor // scale}{suffix}"
+    return f"{floor}B"
+
+
+def run_identity(n_processes: int = 1) -> dict:
+    """This run's (platform, device-count, topology) triple.  Reads only
+    an ALREADY-initialized jax (never forces backend init); host-only
+    jobs calibrate under ``platform="host"``."""
+    platform, count = "host", 0
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            devices = jax.devices()
+            platform = devices[0].platform
+            count = len(devices)
+        except Exception:
+            pass
+    return {
+        "platform": platform,
+        "device_count": count,
+        "topology": f"{max(n_processes, 1)}x{count}",
+    }
+
+
+def _comm_key(ident: dict, collective: str, program: str,
+              bucket: str) -> str:
+    return "|".join([ident["platform"], str(ident["device_count"]),
+                     ident["topology"], collective, program, bucket])
+
+
+def _prog_key(ident: dict, program: str) -> str:
+    return "|".join([ident["platform"], str(ident["device_count"]),
+                     ident["topology"], program])
+
+
+class CalibStore:
+    """In-memory form of the store document, with accumulate/merge/save.
+
+    ``doc`` is the JSON shape on disk: ``{"schema", "version", "comms":
+    {key: row}, "programs": {key: row}, "runs", "updated_unix_s"}``."""
+
+    def __init__(self, path: str | None = None, doc: dict | None = None):
+        self.path = path
+        self.doc = doc if doc is not None else {
+            "schema": CALIB_SCHEMA, "version": CALIB_VERSION,
+            "comms": {}, "programs": {}, "runs": 0,
+        }
+
+    # --- load / validate --------------------------------------------------
+
+    @classmethod
+    def load(cls, path: str) -> "CalibStore":
+        """Load ``<path>`` (a calib.json, or a directory holding one).
+        A missing file is an empty store; an incompatible one REFUSES
+        (:class:`CalibMismatch`) — stale evidence must never silently
+        merge with a new schema's."""
+        if os.path.isdir(path):
+            path = os.path.join(path, CALIB_FILE)
+        store = cls(path=path)
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except FileNotFoundError:
+            return store
+        except (OSError, ValueError) as e:
+            raise CalibMismatch(f"unreadable calibration store {path!r}: "
+                                f"{e}") from e
+        validate_doc(doc, path)
+        store.doc = doc
+        return store
+
+    # --- accumulation (one run's measurements) ----------------------------
+
+    def accumulate_run(self, ident: dict, comms_rows: list | None,
+                       xprof_report: dict | None) -> int:
+        """Fold one finished run's comms table + xprof program rows into
+        this store under ``ident``.  Returns the number of rows
+        touched."""
+        touched = 0
+        for r in comms_rows or []:
+            calls = int(r.get("count") or 0)
+            nbytes = float(r.get("bytes") or 0.0)
+            if calls <= 0:
+                continue
+            bucket = shape_bucket(nbytes / calls)
+            key = _comm_key(ident, r["collective"], r["program"], bucket)
+            row = self.doc["comms"].get(key)
+            if row is None:
+                row = self.doc["comms"][key] = dict(
+                    ident, collective=r["collective"],
+                    program=r["program"], shape_bucket=bucket,
+                    calls=0, bytes=0.0, latency_ms=0.0,
+                    latency_samples=0, runs=0)
+            lat = r.get("latency_ms") or {}
+            samples = int(lat.get("count") or 0)
+            row["calls"] += calls
+            row["bytes"] += nbytes
+            row["latency_ms"] += float(lat.get("mean") or 0.0) * samples
+            row["latency_samples"] += samples
+            row["runs"] += 1
+            row["last_shape"] = r.get("shape")
+            touched += 1
+        for name, p in ((xprof_report or {}).get("programs") or {}).items():
+            dispatches = int(p.get("dispatches") or 0)
+            compiles = int(p.get("compiles") or 0)
+            if dispatches <= 0 and compiles <= 0:
+                continue
+            key = _prog_key(ident, name)
+            row = self.doc["programs"].get(key)
+            if row is None:
+                row = self.doc["programs"][key] = dict(
+                    ident, program=name, dispatches=0, dispatch_ms=0.0,
+                    compute_ms=0.0, compute_samples=0, compiles=0,
+                    compile_ms=0.0, runs=0)
+            row["dispatches"] += dispatches
+            row["dispatch_ms"] += float(p.get("dispatch_ms") or 0.0)
+            row["compute_ms"] += float(p.get("sampled_device_ms") or 0.0)
+            row["compute_samples"] += int(p.get("device_samples") or 0)
+            row["compiles"] += compiles
+            row["compile_ms"] += float(p.get("compile_ms") or 0.0)
+            row["runs"] += 1
+            touched += 1
+        if touched:
+            self.doc["runs"] = int(self.doc.get("runs") or 0) + 1
+        return touched
+
+    # --- merge / persist --------------------------------------------------
+
+    def merge_from(self, other: dict) -> None:
+        """Fold another store DOCUMENT into this one (validated first)."""
+        validate_doc(other)
+        for section in ("comms", "programs"):
+            for key, row in (other.get(section) or {}).items():
+                mine = self.doc[section].get(key)
+                if mine is None:
+                    self.doc[section][key] = dict(row)
+                    continue
+                for field, v in row.items():
+                    if isinstance(v, bool) or not isinstance(
+                            v, (int, float)):
+                        mine.setdefault(field, v)
+                    elif field in _COMM_IDENTITY or field == "device_count":
+                        pass  # identity fields never accumulate
+                    else:
+                        mine[field] = mine.get(field, 0) + v
+        self.doc["runs"] = (int(self.doc.get("runs") or 0)
+                            + int(other.get("runs") or 0))
+
+    def save_merged(self) -> str:
+        """Atomic read-merge-write of ``self.path``: under an ``flock``
+        on a sidecar lock file, re-read whatever is on disk now (another
+        process may have merged since we loaded), fold it in, write
+        temp+rename.  Refuses (raises :class:`CalibMismatch`) instead of
+        overwriting an incompatible store."""
+        if not self.path:
+            raise ValueError("store has no path")
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        lock_path = self.path + ".lock"
+        lock_fd = os.open(lock_path, os.O_WRONLY | os.O_CREAT, 0o644)
+        try:
+            try:
+                import fcntl
+
+                fcntl.flock(lock_fd, fcntl.LOCK_EX)
+            except ImportError:  # pragma: no cover - non-POSIX
+                pass
+            try:
+                with open(self.path) as f:
+                    on_disk = json.load(f)
+            except FileNotFoundError:
+                on_disk = None
+            except (OSError, ValueError) as e:
+                raise CalibMismatch(
+                    f"unreadable calibration store {self.path!r}: {e}"
+                ) from e
+            if on_disk is not None:
+                # self.doc holds ONLY this run's rows (the Obs wiring
+                # seeds an empty store for accumulation; the prior
+                # history loaded at job start is a separate read-only
+                # object), so on-disk + ours never double-counts — even
+                # when another process merged between our load and now
+                merged = CalibStore(path=self.path)
+                merged.merge_from(on_disk)   # validates on_disk
+                merged.merge_from(self.doc)
+                self.doc = merged.doc
+            self.doc["updated_unix_s"] = round(time.time(), 3)
+            from map_oxidize_tpu.obs import write_json_atomic
+
+            write_json_atomic(self.path, self.doc)
+        finally:
+            os.close(lock_fd)
+        return self.path
+
+    # --- reporting --------------------------------------------------------
+
+    def bandwidth_table(self) -> list[dict]:
+        """Per-(identity, collective, program, shape-bucket) bandwidth
+        rows, bytes-heaviest first.  ``gbytes_per_s`` needs sampled
+        latency; rows without samples still carry calls/bytes."""
+        rows = []
+        for row in self.doc.get("comms", {}).values():
+            calls = row.get("calls") or 0
+            out = dict(row)
+            if calls:
+                out["bytes_per_call"] = row["bytes"] / calls
+            samples = row.get("latency_samples") or 0
+            if samples and row.get("latency_ms"):
+                mean_ms = row["latency_ms"] / samples
+                out["mean_latency_ms"] = round(mean_ms, 4)
+                if calls:
+                    out["gbytes_per_s"] = round(
+                        (row["bytes"] / calls) / (mean_ms / 1e3) / 1e9, 4)
+            rows.append(out)
+        rows.sort(key=lambda r: -(r.get("bytes") or 0))
+        return rows
+
+    def program_table(self) -> list[dict]:
+        rows = []
+        for row in self.doc.get("programs", {}).values():
+            out = dict(row)
+            n = row.get("dispatches") or 0
+            if n:
+                out["dispatch_ms_per_call"] = round(
+                    row["dispatch_ms"] / n, 4)
+            s = row.get("compute_samples") or 0
+            if s:
+                out["compute_ms_per_sample"] = round(
+                    row["compute_ms"] / s, 4)
+            rows.append(out)
+        rows.sort(key=lambda r: -(r.get("dispatch_ms") or 0))
+        return rows
+
+
+def validate_doc(doc: dict, path: str = "") -> None:
+    """Schema/version/identity-consistency check; raises
+    :class:`CalibMismatch` with the named reason."""
+    where = f" ({path})" if path else ""
+    if not isinstance(doc, dict) or doc.get("schema") != CALIB_SCHEMA:
+        raise CalibMismatch(
+            f"not a {CALIB_SCHEMA} store{where}: schema="
+            f"{doc.get('schema') if isinstance(doc, dict) else type(doc)}")
+    if doc.get("version") != CALIB_VERSION:
+        raise CalibMismatch(
+            f"calibration store version {doc.get('version')!r} != "
+            f"supported {CALIB_VERSION}{where}; refusing to merge")
+    for section, ident_fields in (("comms", _COMM_IDENTITY),
+                                  ("programs", _PROG_IDENTITY)):
+        for key, row in (doc.get(section) or {}).items():
+            parts = key.split("|")
+            if len(parts) != len(ident_fields):
+                raise CalibMismatch(
+                    f"malformed {section} key {key!r}{where}")
+            for field, part in zip(ident_fields, parts):
+                stored = row.get(field)
+                if str(stored) != part:
+                    raise CalibMismatch(
+                        f"{section} row {key!r}: stored {field}="
+                        f"{stored!r} disagrees with its key{where}; "
+                        "refusing to merge a torn/doctored store")
+
+
+# --- rendering (the `obs calib` table) -------------------------------------
+
+
+from map_oxidize_tpu.obs.metrics import format_bytes as _fmt_bytes  # noqa: E402 - rendering helper
+
+
+def render(store: CalibStore) -> str:
+    """Human-readable store report: the bandwidth curves (grouped by
+    identity + collective + program, one line per shape-bucket) and the
+    per-program dispatch/compute table."""
+    doc = store.doc
+    lines = [f"calibration store: {doc.get('runs', 0)} runs merged"
+             + (f", updated {time.strftime('%Y-%m-%dT%H:%M:%S', time.localtime(doc['updated_unix_s']))}"
+                if doc.get("updated_unix_s") else "")]
+    comms = store.bandwidth_table()
+    if comms:
+        lines.append("collective bandwidth (per shape bucket):")
+        lines.append(f"  {'identity':<12} {'collective':<11} "
+                     f"{'program':<24} {'bucket':>7} {'calls':>7} "
+                     f"{'bytes':>9} {'lat ms':>8} {'GB/s':>7}")
+        for r in comms:
+            ident = f"{r['platform']}/{r['topology']}"
+            lines.append(
+                f"  {ident:<12} {r['collective']:<11} {r['program']:<24} "
+                f"{r['shape_bucket']:>7} {r['calls']:>7} "
+                f"{_fmt_bytes(r['bytes']):>9} "
+                f"{r.get('mean_latency_ms', '-'):>8} "
+                f"{r.get('gbytes_per_s', '-'):>7}")
+    else:
+        lines.append("no collective rows yet (runs with a multi-shard "
+                     "mesh or multi-process exchange populate them)")
+    progs = store.program_table()
+    if progs:
+        lines.append("program dispatch/compute:")
+        lines.append(f"  {'identity':<12} {'program':<28} {'disp':>7} "
+                     f"{'ms/disp':>8} {'compute ms':>10} {'compiles':>8}")
+        for r in progs[:20]:
+            ident = f"{r['platform']}/{r['topology']}"
+            lines.append(
+                f"  {ident:<12} {r['program']:<28} {r['dispatches']:>7} "
+                f"{r.get('dispatch_ms_per_call', '-'):>8} "
+                f"{r.get('compute_ms_per_sample', '-'):>10} "
+                f"{r['compiles']:>8}")
+    return "\n".join(lines)

@@ -1,6 +1,6 @@
 """``python -m map_oxidize_tpu obs ...`` — observability artifact tools.
 
-Five subcommands, all pure host-side work (no jax, no backend init):
+Eight subcommands, all pure host-side work (no jax, no backend init):
 
 * ``obs merge`` — combine a distributed run's per-process trace shards
   (``<trace_out>.proc<i>``) into one Chrome trace (pid = process slot)
@@ -24,9 +24,21 @@ Five subcommands, all pure host-side work (no jax, no backend init):
   change detection against the median of prior entries, and a ranked
   movers report — when a gate trips, the table that says WHICH counter
   moved and when (``--json`` for the structured form).
+* ``obs where`` — the wall-clock attribution report
+  (:mod:`map_oxidize_tpu.obs.attrib`): where every millisecond of a
+  job's wall went — named buckets plus the unattributed remainder —
+  from a metrics document, a crash bundle, or a live ``--url``.
+* ``obs flame`` — renders a deep-profile capture's host sampling
+  stacks (collapsed-stack format): hottest stacks and frames, joined
+  against the attribution buckets.
+* ``obs calib`` — renders the persistent calibration store
+  (``--calib-dir``): per-collective bandwidth curves keyed (platform,
+  devices, topology, collective, program, shape-bucket) plus the
+  per-program dispatch/compute table accumulated across runs.
 * ``obs top`` — live terminal view of a running job: polls the
   ``--obs-port`` server's ``/status`` and redraws phase, rows/sec, ETA,
-  the compile/MFU table, HBM, and the comms table.  Curses-free (plain
+  the compile/MFU table, HBM, the attribution panel, and the comms
+  table.  Curses-free (plain
   ANSI redraw), so it works in any terminal and over ssh.  Renders the
   SLO plane's ``/alerts`` panel (firing + recently-resolved) when the
   evaluator is running, and — pointed at a RESIDENT job server
@@ -125,6 +137,45 @@ def build_obs_parser() -> argparse.ArgumentParser:
     tr.add_argument("--json", action="store_true",
                     help="emit the structured analysis as JSON")
 
+    w = sub.add_parser(
+        "where", help="wall-clock attribution report: where every "
+                      "millisecond of a job's wall went (buckets + the "
+                      "unattributed remainder), from a --metrics-out "
+                      "document, a crash bundle, or a live /status URL")
+    w.add_argument("metrics", nargs="?", default=None,
+                   help="a run's --metrics-out JSON, an obs shard, or a "
+                        "flight-recorder bundle directory (omit with "
+                        "--url)")
+    w.add_argument("--url", default=None,
+                   help="a LIVE job/server obs URL (e.g. "
+                        "http://127.0.0.1:8321): render the current "
+                        "/status attribution instead of a document")
+    w.add_argument("--json", action="store_true",
+                   help="emit the structured attribution document")
+
+    fl = sub.add_parser(
+        "flame", help="render a deep-profile capture's host sampling "
+                      "stacks (collapsed-stack format): hottest stacks "
+                      "and frames, joined against the wall-attribution "
+                      "buckets")
+    fl.add_argument("profile", help="a capture bundle directory "
+                                    "(profile_<stamp>/), a --profile-dir "
+                                    "root (newest capture wins), or a "
+                                    "host_stacks.collapsed file")
+    fl.add_argument("--top", type=int, default=15,
+                    help="stacks/frames to list (default 15)")
+
+    cb = sub.add_parser(
+        "calib", help="render the persistent calibration store "
+                      "(--calib-dir): per-collective bandwidth curves "
+                      "keyed (platform, devices, topology, collective, "
+                      "program, shape-bucket) plus per-program dispatch/"
+                      "compute figures accumulated across runs")
+    cb.add_argument("store", help="the --calib-dir directory (or its "
+                                  "calib.json)")
+    cb.add_argument("--json", action="store_true",
+                    help="emit the raw store document")
+
     t = sub.add_parser(
         "top", help="live terminal view of a running job: poll the "
                     "--obs-port server's /status and redraw")
@@ -152,7 +203,127 @@ def obs_main(argv: list[str]) -> int:
         return _top(args)
     if args.cmd == "trend":
         return _trend(args)
+    if args.cmd == "where":
+        return _where(args)
+    if args.cmd == "flame":
+        return _flame(args)
+    if args.cmd == "calib":
+        return _calib(args)
     return _diff(args)
+
+
+def _where(args) -> int:
+    import json
+
+    from map_oxidize_tpu.obs.attrib import render
+
+    if args.url:
+        import urllib.request
+
+        url = args.url.rstrip("/") + "/status"
+        try:
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                status = json.loads(resp.read())
+        except (OSError, ValueError) as e:
+            print(f"error: cannot reach {url}: {e}", file=sys.stderr)
+            return 2
+        doc = status.get("attrib")
+        title = (f"where did the time go — {status.get('phase') or '?'} "
+                 f"(live)")
+    elif args.metrics:
+        path = resolve_metrics_path(args.metrics)
+        try:
+            with open(path) as f:
+                mdoc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"error: cannot read metrics document {path!r}: {e}",
+                  file=sys.stderr)
+            return 2
+        if mdoc.get("schema"):  # an obs shard nests the metrics doc
+            mdoc = mdoc.get("metrics", {})
+        doc = mdoc.get("attrib")
+        wl = (mdoc.get("meta") or {}).get("workload")
+        title = f"where did the time go — {wl or '?'}"
+    else:
+        print("error: obs where needs a metrics document or --url",
+              file=sys.stderr)
+        return 2
+    if not doc:
+        print("error: no attrib section (produced by a pre-attribution "
+              "version?)", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(doc, indent=1, sort_keys=True))
+        return 0
+    print(render(doc, title=title))
+    return 0
+
+
+def resolve_profile_stacks(path: str) -> "tuple[str, str | None]":
+    """Resolve an ``obs flame`` argument to ``(collapsed_path,
+    profile_json_path)``: a collapsed file directly, a capture bundle
+    directory, or a --profile-dir root (newest capture)."""
+    if os.path.isfile(path):
+        side = os.path.join(os.path.dirname(path), "profile.json")
+        return path, side if os.path.isfile(side) else None
+    direct = os.path.join(path, "host_stacks.collapsed")
+    if os.path.isfile(direct):
+        return direct, (os.path.join(path, "profile.json")
+                        if os.path.isfile(os.path.join(path,
+                                                       "profile.json"))
+                        else None)
+    bundles = sorted(glob.glob(os.path.join(path, "profile_*",
+                                            "host_stacks.collapsed")))
+    if bundles:
+        newest = bundles[-1]
+        side = os.path.join(os.path.dirname(newest), "profile.json")
+        return newest, side if os.path.isfile(side) else None
+    return path, None
+
+
+def _flame(args) -> int:
+    import json
+
+    from map_oxidize_tpu.obs.profiler import flame_report
+
+    stacks_path, profile_path = resolve_profile_stacks(args.profile)
+    try:
+        with open(stacks_path) as f:
+            text = f.read()
+    except OSError as e:
+        print(f"error: cannot read collapsed stacks {stacks_path!r}: {e}",
+              file=sys.stderr)
+        return 2
+    attrib_doc = None
+    if profile_path:
+        try:
+            with open(profile_path) as f:
+                attrib_doc = json.load(f).get("attrib")
+        except (OSError, ValueError):
+            pass
+    print(flame_report(text, attrib_doc=attrib_doc, top=args.top))
+    return 0
+
+
+def _calib(args) -> int:
+    import json
+
+    from map_oxidize_tpu.obs.calib import CalibMismatch, CalibStore, render
+
+    try:
+        store = CalibStore.load(args.store)
+    except CalibMismatch as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if not store.doc.get("comms") and not store.doc.get("programs"):
+        print(f"error: no calibration store at {args.store!r} (runs "
+              "merge into it via --calib-dir)", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(store.doc, indent=1, sort_keys=True))
+        return 0
+    print(render(store))
+    return 0
 
 
 def resolve_metrics_path(path: str) -> str:
@@ -314,10 +485,23 @@ def _trend(args) -> int:
         except (OSError, ValueError) as e:
             print(f"error: cannot read bench round: {e}", file=sys.stderr)
             return 2
-        if len(entries) >= 2:
-            groups.append(("bench-rounds", entries))
-        else:
-            print(f"error: need >= 2 bench rounds, got {len(entries)}",
+        # BENCH and MULTICHIP rounds load side by side but trend as
+        # separate groups — a scoreboard ratio and a dryrun pass flag
+        # share no axis
+        by_kind: dict[str, list] = {}
+        for e in entries:
+            by_kind.setdefault(e["workload"], []).append(e)
+        ok = False
+        for kind, es in sorted(by_kind.items()):
+            if len(es) >= 2:
+                groups.append((kind, es))
+                ok = True
+            else:
+                print(f"({kind}: only {len(es)} round — need >= 2 to "
+                      "trend)")
+        if not ok:
+            print(f"error: need >= 2 rounds of a kind, got "
+                  f"{ {k: len(v) for k, v in by_kind.items()} }",
                   file=sys.stderr)
             return 2
     if args.ledger_dir:
@@ -366,14 +550,7 @@ def _trend(args) -> int:
 # --- obs top ---------------------------------------------------------------
 
 
-def _fmt_bytes(n) -> str:
-    if not isinstance(n, (int, float)):
-        return "-"
-    for scale, suffix in ((1 << 40, "TB"), (1 << 30, "GB"), (1 << 20, "MB"),
-                          (1 << 10, "KB")):
-        if n >= scale:
-            return f"{n / scale:.2f}{suffix}"
-    return f"{n:.0f}B"
+from map_oxidize_tpu.obs.metrics import format_bytes as _fmt_bytes
 
 
 def render_status(doc: dict) -> str:
@@ -429,6 +606,11 @@ def render_status(doc: dict) -> str:
                 f"{c['shape']:<12} {c['count']:>6} "
                 f"{_fmt_bytes(c['bytes']):>9} "
                 f"{p50 if p50 is not None else '-':>7}")
+    at = doc.get("attrib")
+    if at:
+        from map_oxidize_tpu.obs.attrib import render as render_attrib
+
+        lines.append(render_attrib(at, title="where"))
     agg = doc.get("aggregate")
     if agg:
         lines.append(

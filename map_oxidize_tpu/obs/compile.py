@@ -459,7 +459,13 @@ class ObservedJit:
         new_sig = sig not in stats.sigs
         if new_sig:
             # cost analysis from the lowering — BEFORE the call, so
-            # donated operands are still live; no backend compile happens
+            # donated operands are still live; no backend compile
+            # happens.  The lowering itself is real wall (hundreds of
+            # ms for a shard_map program) paid OUTSIDE the timed call
+            # below — it feeds the attribution ledger's compile bucket
+            # as attrib/lowering_ms, else the observatory's own
+            # overhead would read as unattributed remainder
+            t_lower = time.perf_counter()
             try:
                 ca = self._fn.lower(*args, **kw).cost_analysis()
                 if isinstance(ca, (list, tuple)):
@@ -470,6 +476,14 @@ class ObservedJit:
                     cost = (fl if fl > 0 else None, by if by > 0 else None)
             except Exception:
                 cost = None
+            _lobs = led._job()[0]
+            # phase-open guard mirrors pick_device's: a pre-phase
+            # lowering is already covered by the setup gauge's window
+            if _lobs is not None and getattr(_lobs, "current_phase",
+                                             None):
+                _lobs.registry.count(
+                    "attrib/lowering_ms",
+                    (time.perf_counter() - t_lower) * 1e3)
         before = self._cache_n()
         tls = led._tls
         prev_cur = getattr(tls, "current", None)

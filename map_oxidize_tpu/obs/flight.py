@@ -74,12 +74,26 @@ def _record(obs, config, exc, workload):
     # the crash lands in the bundle (an abort mid-recompile-storm is
     # exactly when the compile ledger matters)
     xprof_report = obs.finish_xprof()
+    # wall attribution as of the abort: where the time went BEFORE the
+    # job died is first-order post-mortem evidence (buckets land as
+    # attrib/* gauges in the bundle's metrics document too)
+    attrib_doc = None
+    try:
+        from map_oxidize_tpu.obs import attrib as _attrib
+
+        attrib_doc = _attrib.finalize(
+            obs, xprof_report,
+            max(time.time() - obs.tracer.wall_start, 1e-9))
+    except Exception:  # pragma: no cover - defensive
+        pass
     sample_host_memory(obs.registry)
     sample_device_memory(obs.registry)
     obs.registry.set("aborted", True)
 
     meta = obs.stamp(config, workload)
     metrics_doc = dict(obs.registry.to_dict(), meta=meta)
+    if attrib_doc is not None:
+        metrics_doc["attrib"] = attrib_doc
     if xprof_report is not None:
         metrics_doc["xprof"] = xprof_report
     if obs.series is not None:

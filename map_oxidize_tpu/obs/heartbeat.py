@@ -49,6 +49,10 @@ class Heartbeat:
         #: live HBM in use (max over devices), fed by the obs device
         #: sampler thread when one is running; None keeps it off the line
         self.hbm_bytes: int | None = None
+        #: live one-token wall attribution (e.g. ``compute 61%``),
+        #: refreshed by the time-series recorder's attribution tick;
+        #: None keeps it off the line (no live plane = no ledger)
+        self.where: str | None = None
         #: True when this heartbeat only TRACKS progress (the live
         #: telemetry plane's /status feed) and emits no lines — warning
         #: producers (stall detector, recompile warnings) must then fall
@@ -110,6 +114,8 @@ class Heartbeat:
                 parts.append(f"eta={_fmt_eta(eta)}")
         if self.hbm_bytes is not None:
             parts.append(f"hbm={self.hbm_bytes / (1 << 30):.2f}GB")
+        if self.where is not None:
+            parts.append(f"where={self.where}")
         self._emit(" ".join(parts))
 
 

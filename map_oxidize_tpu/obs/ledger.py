@@ -40,6 +40,7 @@ _NON_IDENTITY_FIELDS = frozenset({
     "hbm_sample_s", "stall_warn_factor",
     "obs_port", "obs_sample_s",
     "slo_rules", "incident_dir",
+    "calib_dir", "profile_dir", "host_sample_hz",
     "dist_coordinator", "dist_process_id",
 })
 
@@ -275,6 +276,26 @@ def diff_entries(a: dict, b: dict, threshold_pct: float = 10.0,
             if isinstance(vb, (int, float)) and vb > va_n:
                 regressions.append(
                     f"{name}: {va_n:g} -> {vb:g} SLO alerts fired")
+        elif name == "attrib/unattributed_pct":
+            # attribution-coverage gate: the unattributed remainder
+            # growing by more than a fixed number of percentage points
+            # means the wall decomposition lost coverage (a new code
+            # path nobody bucket-fed, a counter that stopped flowing) —
+            # a regression of the measurement plane itself.  Points,
+            # not relative percent: 2% -> 5% is noise, 5% -> 25% is a
+            # hole, and a relative threshold would invert that.
+            from map_oxidize_tpu.obs.attrib import (
+                UNATTRIBUTED_GATE_POINTS,
+            )
+
+            if va != vb:
+                rows.append((name, va, vb, pct))
+            va_n = va if isinstance(va, (int, float)) else 0
+            if (isinstance(vb, (int, float))
+                    and vb - va_n > UNATTRIBUTED_GATE_POINTS):
+                regressions.append(
+                    f"{name}: {va_n:.1f}% -> {vb:.1f}% of wall "
+                    "unattributed (attribution coverage regression)")
         elif name == "heartbeat/stalls":
             # stall episodes are evidence of a wedged feed loop or a
             # straggler-gated collective; ANY increase flags

@@ -335,6 +335,18 @@ def _shape_str(shape) -> str:
         return str(shape)
 
 
+def format_bytes(n) -> str:
+    """Human-readable byte count (the shared table-rendering helper —
+    `obs top`, `obs calib`)."""
+    if not isinstance(n, (int, float)):
+        return "-"
+    for scale, suffix in ((1 << 40, "TB"), (1 << 30, "GB"),
+                          (1 << 20, "MB"), (1 << 10, "KB")):
+        if n >= scale:
+            return f"{n / scale:.2f}{suffix}"
+    return f"{n:.0f}B"
+
+
 # --- memory watermarks ----------------------------------------------------
 
 

@@ -1,0 +1,353 @@
+"""On-demand deep profiling: device traces + a host sampling profiler.
+
+The attribution ledger (:mod:`map_oxidize_tpu.obs.attrib`) says *which
+bucket* ate the wall; this module answers the next question — *which
+code* — without restarting the job:
+
+* :func:`capture` drives one bounded-duration capture on a LIVE run or
+  resident server: a ``jax.profiler`` device trace (XLA's own timeline,
+  TensorBoard-compatible) plus a lightweight **host sampling profiler**
+  (a daemon thread snapshotting every Python thread's stack at
+  ``--host-sample-hz`` via ``sys._current_frames`` — no interpreter
+  hooks, overhead is one frame walk per thread per tick).  Artifacts
+  land under ``--profile-dir`` (a resident server spools them under
+  ``<spool>/profiles``): ``profile.json`` (``moxt-profile-v1``: meta,
+  sample counts, the attribution snapshot at capture time),
+  ``host_stacks.collapsed`` (flamegraph collapsed-stack format — feed
+  it to any flamegraph tool, or ``obs flame``), and ``device/`` (the
+  jax trace, when a device runtime is up).
+* a **single-capture mutex**: ``jax.profiler`` is process-global and a
+  second concurrent host sampler would only halve both captures'
+  fidelity — concurrent requests get :class:`CaptureBusy` (HTTP 409 at
+  ``POST /profile``, see :mod:`map_oxidize_tpu.obs.serve`).
+* :func:`device_trace` is the ONE whole-job ``jax.profiler`` wrapper —
+  the CLI ``--trace-dir`` flag (formerly ``utils.profiling.jax_trace``,
+  now a thin alias) runs through it, and :func:`capture` detects an
+  already-active whole-job trace instead of crashing into XLA's
+  "profiler already started".
+
+``obs flame`` (:mod:`map_oxidize_tpu.obs.cli`) renders the collapsed
+stacks and joins the host hotspots against the attribution buckets.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+
+from map_oxidize_tpu.utils.logging import get_logger
+
+_log = get_logger(__name__)
+
+PROFILE_SCHEMA = "moxt-profile-v1"
+
+#: bounded capture: /profile refuses longer requests (a forgotten 1h
+#: capture pinning the mutex and the trace buffers is an outage, not a
+#: profile)
+MAX_CAPTURE_S = 120.0
+DEFAULT_CAPTURE_S = 3.0
+DEFAULT_HOST_HZ = 50.0
+
+#: the single-capture mutex (process-global, like jax.profiler itself)
+_capture_lock = threading.Lock()
+
+#: per-process capture ordinal: bundle names carry it so two captures
+#: in the same wall-clock second never overwrite each other's artifacts
+_capture_seq = 0
+
+#: True while a whole-job --trace-dir device trace is active: capture()
+#: then skips its device leg with a named note instead of colliding
+_device_trace_active = False
+
+
+class CaptureBusy(RuntimeError):
+    """A capture is already running (the mutex is held)."""
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str | None):
+    """Whole-job ``jax.profiler`` trace into ``log_dir`` (None = no-op).
+    The one implementation behind the CLI ``--trace-dir`` flag and the
+    retired ``utils.profiling.jax_trace`` alias."""
+    global _device_trace_active
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    _device_trace_active = True
+    try:
+        yield
+    finally:
+        _device_trace_active = False
+        jax.profiler.stop_trace()
+
+
+class HostSampler:
+    """Daemon thread snapshotting all Python thread stacks at ``hz``.
+
+    Aggregates into collapsed-stack form: ``thread;outer;...;leaf`` ->
+    sample count, frames spelled ``module.py:function``.  ``hz`` is an
+    upper bound — a slow frame walk simply lowers the achieved rate
+    (recorded honestly in ``samples``/``duration``)."""
+
+    def __init__(self, hz: float = DEFAULT_HOST_HZ):
+        if hz <= 0:
+            raise ValueError("host sample rate must be positive")
+        self.hz = float(hz)
+        self.stacks: dict[str, int] = {}
+        self.samples = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="obs-host-sampler")
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+    def sample_once(self) -> None:
+        me = threading.get_ident()
+        names = {t.ident: t.name for t in threading.enumerate()}
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue  # the sampler observing itself is noise
+            parts: list[str] = []
+            f = frame
+            while f is not None:
+                code = f.f_code
+                parts.append(f"{os.path.basename(code.co_filename)}:"
+                             f"{code.co_name}")
+                f = f.f_back
+            parts.append(names.get(tid, f"thread-{tid}"))
+            key = ";".join(reversed(parts))
+            self.stacks[key] = self.stacks.get(key, 0) + 1
+        self.samples += 1
+
+    def _run(self) -> None:
+        interval = 1.0 / self.hz
+        while not self._stop.wait(interval):
+            try:
+                self.sample_once()
+            except Exception:  # a torn frame walk must not kill capture
+                pass
+
+    def collapsed(self) -> str:
+        """Flamegraph collapsed-stack text: one ``stack count`` line per
+        distinct stack, hottest first."""
+        return "\n".join(
+            f"{stack} {n}" for stack, n in sorted(
+                self.stacks.items(), key=lambda kv: (-kv[1], kv[0])))
+
+
+def default_profile_dir(config) -> str:
+    """Where a capture lands when the job/server config has no explicit
+    ``--profile-dir``: next to the crash bundles, else next to the
+    metrics document, else ``./moxt-profiles``."""
+    explicit = getattr(config, "profile_dir", None)
+    if explicit:
+        return explicit
+    crash = getattr(config, "crash_dir", None)
+    if crash:
+        return os.path.join(crash, "profiles")
+    metrics_out = getattr(config, "metrics_out", None)
+    if metrics_out:
+        return os.path.join(os.path.dirname(os.path.abspath(metrics_out)),
+                            "profiles")
+    return "moxt-profiles"
+
+
+def capture(out_dir: str, duration_s: float = DEFAULT_CAPTURE_S,
+            host_sample_hz: float = DEFAULT_HOST_HZ, device: bool = True,
+            obs=None, extra_meta: dict | None = None) -> dict:
+    """One bounded deep capture; blocks for ``duration_s`` and returns
+    the ``profile.json`` document (artifact paths included).
+
+    Raises :class:`CaptureBusy` when another capture holds the mutex and
+    ``ValueError`` on an out-of-bounds duration.  ``obs`` (optional)
+    contributes the live attribution snapshot and the
+    ``profile/captures`` counter."""
+    if not 0 < duration_s <= MAX_CAPTURE_S:
+        raise ValueError(f"capture duration must be in (0, {MAX_CAPTURE_S}]"
+                         f" seconds, got {duration_s}")
+    if not _capture_lock.acquire(blocking=False):
+        raise CaptureBusy("a profile capture is already running")
+    try:
+        global _capture_seq
+        _capture_seq += 1
+        stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime())
+        bundle = os.path.join(
+            out_dir,
+            f"profile_{stamp}_{os.getpid()}_{_capture_seq:03d}")
+        os.makedirs(bundle, exist_ok=True)
+        device_doc: dict = {"requested": bool(device)}
+        device_dir = os.path.join(bundle, "device")
+        started_device = False
+        if device and _device_trace_active:
+            device_doc["skipped"] = ("a whole-job --trace-dir device "
+                                    "trace is already active")
+        elif device:
+            try:
+                import jax
+
+                jax.profiler.start_trace(device_dir)
+                started_device = True
+                device_doc["dir"] = device_dir
+            except Exception as e:
+                device_doc["error"] = f"{type(e).__name__}: {e}"
+        sampler = HostSampler(host_sample_hz)
+        t0 = time.time()
+        sampler.start()
+        try:
+            time.sleep(duration_s)
+        finally:
+            sampler.stop()
+            if started_device:
+                try:
+                    import jax
+
+                    jax.profiler.stop_trace()
+                except Exception as e:
+                    device_doc["error"] = f"{type(e).__name__}: {e}"
+        collapsed_path = os.path.join(bundle, "host_stacks.collapsed")
+        with open(collapsed_path, "w") as f:
+            f.write(sampler.collapsed() + "\n")
+        doc: dict = {
+            "schema": PROFILE_SCHEMA,
+            "t_unix_s": round(t0, 3),
+            "duration_s": round(time.time() - t0, 3),
+            "requested_duration_s": duration_s,
+            "host_sample_hz": host_sample_hz,
+            "host_samples": sampler.samples,
+            "distinct_stacks": len(sampler.stacks),
+            "threads": [t.name for t in threading.enumerate()],
+            "dir": bundle,
+            "host_stacks": collapsed_path,
+            "device": device_doc,
+        }
+        if extra_meta:
+            doc["meta"] = extra_meta
+        if obs is not None:
+            # the resident SERVER's own bundle has no job wall to
+            # decompose (same skip the /status and series surfaces
+            # apply) — jobs' bundles attribute themselves
+            if getattr(obs, "workload", None) != "serve":
+                try:
+                    from map_oxidize_tpu.obs import attrib
+
+                    doc["attrib"] = attrib.compute(obs)
+                except Exception:  # pragma: no cover - defensive
+                    pass
+            obs.registry.count("profile/captures")
+        from map_oxidize_tpu.obs import write_json_atomic
+
+        write_json_atomic(os.path.join(bundle, "profile.json"), doc)
+        _log.info("[profile] captured %.1fs (%d host samples) -> %s",
+                  doc["duration_s"], sampler.samples, bundle)
+        return doc
+    finally:
+        _capture_lock.release()
+
+
+# --- collapsed-stack analysis (the `obs flame` report) ---------------------
+
+#: (frame substring, bucket) in PRIORITY order: the first needle found
+#: anywhere in a stack wins, so a specific site (the prefetch consumer
+#: blocked in queue.get) beats the generic threading.wait it bottoms
+#: out in.  The heuristics only need to be good enough to say "this hot
+#: stack is the producer / the stall / the dispatch path", matching the
+#: ledger's bucket names so the two reports join.
+_FRAME_BUCKETS = (
+    ("pipeline.py:_produce", "host_produce"),
+    ("kmeans.py:_stage", "host_produce"),
+    ("spill.py:", "spill_io"),
+    ("disk.py:", "spill_io"),
+    (":block_until_ready", "device_compute"),
+    ("compile.py:__call__", "dispatch_gap"),
+    ("pjit.py:", "dispatch_gap"),
+    ("profiler.py:", "profiler"),
+    ("pipeline.py:__iter__", "feed_wait"),
+    ("queue.py:get", "feed_wait"),
+    ("selectors.py:", "idle"),
+    ("socketserver.py:", "idle"),
+    ("threading.py:wait", "idle"),
+)
+
+
+def parse_collapsed(text: str) -> list[tuple[list[str], int]]:
+    """Parse collapsed-stack lines into ``(frames, count)`` rows."""
+    rows = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        stack, _, n = line.rpartition(" ")
+        try:
+            count = int(n)
+        except ValueError:
+            continue
+        rows.append((stack.split(";"), count))
+    return rows
+
+
+def classify_stack(frames: list[str]) -> str:
+    """Bucket one sampled stack: needles are checked in priority order
+    against the whole stack (specific sites outrank the generic waits
+    they nest in)."""
+    for needle, bucket in _FRAME_BUCKETS:
+        for frame in frames:
+            if needle in frame:
+                return bucket
+    return "other"
+
+
+def flame_report(text: str, attrib_doc: dict | None = None,
+                 top: int = 15) -> str:
+    """The ``obs flame`` stdout: hottest stacks, hottest leaf frames,
+    and the sampled-share vs ledger-attributed-share join."""
+    rows = parse_collapsed(text)
+    total = sum(n for _f, n in rows) or 1
+    lines = [f"host sampling profile: {total} samples, "
+             f"{len(rows)} distinct stacks"]
+    lines.append("hot stacks:")
+    for frames, n in rows[:top]:
+        tail = ";".join(frames[-4:])
+        lines.append(f"  {100.0 * n / total:5.1f}%  {frames[0]}: ...{tail}")
+    leaves: dict[str, int] = {}
+    buckets: dict[str, int] = {}
+    for frames, n in rows:
+        leaves[frames[-1]] = leaves.get(frames[-1], 0) + n
+        b = classify_stack(frames)
+        buckets[b] = buckets.get(b, 0) + n
+    lines.append("hot frames (leaf):")
+    for leaf, n in sorted(leaves.items(), key=lambda kv: -kv[1])[:top]:
+        lines.append(f"  {100.0 * n / total:5.1f}%  {leaf}")
+    lines.append("sampled share by attribution bucket"
+                 + (" (vs wall-clock ledger):" if attrib_doc else ":"))
+    ledger = {}
+    if attrib_doc:
+        ledger = {name: row["pct"]
+                  for name, row in (attrib_doc.get("buckets") or {}).items()}
+        ledger["unattributed"] = attrib_doc.get("unattributed_pct")
+    for b, n in sorted(buckets.items(), key=lambda kv: -kv[1]):
+        line = f"  {b:<16} {100.0 * n / total:5.1f}% sampled"
+        lpct = ledger.get(b)
+        if lpct is not None:
+            line += f"  | {lpct:5.1f}% of wall (ledger)"
+        lines.append(line)
+    return "\n".join(lines)
+
+
+def format_capture_error(exc: BaseException) -> dict:
+    """Uniform error body for the HTTP layer."""
+    return {"error": f"{type(exc).__name__}: {exc}",
+            "traceback": "".join(traceback.format_exception(
+                type(exc), exc, exc.__traceback__))[-2000:]}

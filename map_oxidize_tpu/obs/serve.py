@@ -256,6 +256,17 @@ def build_status(obs, config, workload: str | None = None) -> dict:
         if transport is not None or spill:
             doc["shuffle"] = dict(spill, transport=transport)
     doc["comms"] = obs.registry.comms_table()
+    # live wall attribution: the same decomposition the obs where CLI
+    # renders post-hoc, computed against the running overlay.  The
+    # resident SERVER's own bundle is skipped — it idles between jobs,
+    # so "job wall" is meaningless there (each job attributes itself)
+    if workload != "serve":
+        try:
+            from map_oxidize_tpu.obs import attrib
+
+            doc["attrib"] = attrib.compute(obs)
+        except Exception:  # a decomposition bug must not break /status
+            pass
     # open span stacks (what the job is doing RIGHT NOW), when tracing
     if obs.tracer.enabled:
         stacks = []
@@ -307,7 +318,8 @@ class _Handler(BaseHTTPRequestHandler):
         path = self.path.split("?", 1)[0]
         try:
             if path in ("/", "/healthz"):
-                eps = ["/metrics", "/status", "/series", "/alerts"]
+                eps = ["/metrics", "/status", "/series", "/alerts",
+                       "POST /profile"]
                 if srv.scheduler is not None:
                     eps += ["/jobs", "/jobs/<id>"]
                 self._json({"endpoints": eps, "schema": STATUS_SCHEMA})
@@ -369,11 +381,6 @@ class _Handler(BaseHTTPRequestHandler):
         path = self.path.split("?", 1)[0]
         sched = srv.scheduler
         try:
-            if sched is None:
-                self._json({"error": "no job scheduler attached "
-                                     "(not a resident job server)"},
-                           code=404)
-                return
             try:
                 n = int(self.headers.get("Content-Length") or 0)
                 body = json.loads(self.rfile.read(n) or b"{}")
@@ -381,6 +388,18 @@ class _Handler(BaseHTTPRequestHandler):
                     raise ValueError("request body must be a JSON object")
             except (ValueError, OSError) as e:
                 self._json({"error": f"bad request body: {e}"}, code=400)
+                return
+            if path == "/profile":
+                # deep-capture on the LIVE process (plain job servers
+                # and resident servers alike): blocks for the bounded
+                # duration, returns the profile document; a concurrent
+                # capture gets 409 (single-capture mutex)
+                self._profile(body)
+                return
+            if sched is None:
+                self._json({"error": "no job scheduler attached "
+                                     "(not a resident job server)"},
+                           code=404)
                 return
             if path == "/jobs":
                 try:
@@ -418,6 +437,55 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json({"error": f"{type(e).__name__}: {e}"}, code=500)
             except Exception:
                 pass
+
+    def _profile(self, body: dict) -> None:
+        """``POST /profile``: one bounded deep capture (device trace +
+        host sampling profiler) on this process.  Body (all optional):
+        ``duration_s``, ``host_sample_hz``, ``device`` (bool),
+        ``label``.  Artifacts land under the job/server profile
+        directory (``--profile-dir``; a resident server spools them
+        under ``<spool>/profiles``)."""
+        from map_oxidize_tpu.obs import profiler
+
+        srv = self.server
+        try:
+            duration = float(body.get("duration_s",
+                                      profiler.DEFAULT_CAPTURE_S))
+            hz = float(body.get("host_sample_hz") or getattr(
+                srv.config, "host_sample_hz", 0)
+                or profiler.DEFAULT_HOST_HZ)
+            device = bool(body.get("device", True))
+        except (TypeError, ValueError) as e:
+            self._json({"error": f"bad /profile body: {e}"}, code=400)
+            return
+        if not 0 < hz <= 1000:
+            # same bound JobConfig.validate enforces on the config-level
+            # knob: an unbounded request rate would hot-loop the sampler
+            # thread against the very job it is observing
+            self._json({"error": "host_sample_hz must be in (0, 1000]"},
+                       code=400)
+            return
+        out_dir = profiler.default_profile_dir(srv.config)
+        meta: dict = {}
+        if body.get("label"):
+            meta["label"] = str(body["label"])[:128]
+        if srv.scheduler is not None:
+            # a resident server's capture is process-wide; record which
+            # jobs were live so the profile joins back to them
+            try:
+                meta["running_jobs"] = sorted(srv.scheduler._running)
+            except Exception:
+                pass
+        try:
+            doc = profiler.capture(
+                out_dir, duration_s=duration, host_sample_hz=hz,
+                device=device, obs=srv.obs, extra_meta=meta or None)
+        except profiler.CaptureBusy as e:
+            self._json({"error": str(e)}, code=409)
+        except ValueError as e:
+            self._json({"error": str(e)}, code=400)
+        else:
+            self._json(doc)
 
     def _ok(self, body: bytes, ctype: str, code: int = 200) -> None:
         self.send_response(code)

@@ -132,6 +132,21 @@ class TimeSeriesRecorder:
         return snap
 
     def sample_once(self) -> None:
+        # the resident SERVER's own bundle has no job wall to decompose
+        # (it idles between jobs; each job's bundle attributes itself)
+        if (self.obs is not None
+                and getattr(self.obs, "workload", None) != "serve"):
+            # refresh the live wall attribution FIRST: the attrib/*
+            # gauges (and the heartbeat's where= token) are maintained
+            # at the sampling cadence, so this tick's snapshot — and
+            # every /status, /metrics, /series read between ticks —
+            # carries a current decomposition
+            try:
+                from map_oxidize_tpu.obs import attrib
+
+                attrib.live_update(self.obs)
+            except Exception:  # a decomposition bug must not stop
+                pass           # telemetry sampling
         sample = (self._clock(), self._snapshot())
         with self._lock:
             if len(self._ring) < self.capacity:

@@ -87,8 +87,10 @@ def _direction(name: str, pct: float | None) -> str:
         return "new"
     up_bad = (name.startswith(("phase/", "compile/", "alerts/"))
               or name.endswith(("_s", "_ms", "/p50", "/p95", "/max"))
-              or "stall" in name or "spill" in name)
-    down_bad = (name in ("rate", "records_per_sec")
+              or "stall" in name or "spill" in name
+              or name in ("rc", "unattributed_pct",
+                          "attrib/unattributed_pct"))
+    down_bad = (name in ("rate", "records_per_sec", "ok")
                 or name.endswith(("/mfu_pct", "_per_sec", "overlap_ratio",
                                   "vs_baseline")))
     if up_bad:
@@ -181,24 +183,46 @@ def analyze(entries: list[dict], threshold_pct: float = 25.0,
 
 
 def bench_rounds(paths: list[str]) -> list[dict]:
-    """Adapt ``BENCH_r*.json`` round artifacts into ledger-shaped
-    entries (sorted by filename = round order): the parsed headline
-    value plus every per-workload scoreboard ratio."""
+    """Adapt round artifacts into ledger-shaped entries (sorted by
+    filename = round order).  Two shapes load:
+
+    * ``BENCH_r*.json`` — the parsed headline value plus every
+      per-workload scoreboard ratio (workload ``bench-rounds``);
+    * ``MULTICHIP_r*.json`` — the multichip dryrun smoke record
+      (``n_devices``/``rc``/``ok``/``skipped``, workload
+      ``multichip-rounds``), so multichip trajectories get the same
+      movers report: an ``ok`` flipping 1 -> 0, or ``rc`` appearing
+      from nothing, ranks first.
+
+    Mixed path lists are fine — the CLI groups entries by workload, so
+    the two families trend separately, never against each other."""
     entries = []
     for path in sorted(paths):
         with open(path) as f:
             doc = json.load(f)
         parsed = doc.get("parsed", doc)  # raw BENCH_DETAIL works too
         metrics: dict = {}
-        if _numeric(parsed.get("value")):
-            metrics["headline"] = parsed["value"]
-        if _numeric(parsed.get("vs_baseline")):
-            metrics["vs_baseline"] = parsed["vs_baseline"]
-        for name, ratio in (parsed.get("workloads") or {}).items():
-            if _numeric(ratio):
-                metrics[f"workloads/{name}/vs_baseline"] = ratio
+        workload = "bench-rounds"
+        if "n_devices" in doc and "workloads" not in parsed:
+            # the multichip smoke record: no scoreboard, but pass/fail
+            # and the device count ARE the trajectory
+            workload = "multichip-rounds"
+            for key in ("n_devices", "rc"):
+                if _numeric(doc.get(key)):
+                    metrics[key] = doc[key]
+            for key in ("ok", "skipped"):
+                if isinstance(doc.get(key), bool):
+                    metrics[key] = int(doc[key])
+        else:
+            if _numeric(parsed.get("value")):
+                metrics["headline"] = parsed["value"]
+            if _numeric(parsed.get("vs_baseline")):
+                metrics["vs_baseline"] = parsed["vs_baseline"]
+            for name, ratio in (parsed.get("workloads") or {}).items():
+                if _numeric(ratio):
+                    metrics[f"workloads/{name}/vs_baseline"] = ratio
         entries.append({
-            "workload": "bench-rounds",
+            "workload": workload,
             "label": path.rsplit("/", 1)[-1],
             "phases_s": {},
             "metrics": metrics,

@@ -233,6 +233,14 @@ def kmeans_fit_streamed(path: str, centroids, iters: int = 1,
         staged_blocks,
     )
 
+    # framework bring-up window: mesh/backend init, the step build, and
+    # the initial device puts all happen INSIDE the driver's iterate
+    # phase (unlike the fold engines, which construct before the first
+    # phase and land in the attribution ledger's pre-phase ``setup``
+    # gauge) — measured here so it feeds the setup bucket instead of
+    # the unattributed remainder.  The produce probe's wall is excluded:
+    # it counts into host_produce via record_dispatch_batch.
+    t_init = time.perf_counter()
     if mesh is None:
         if device is not None:
             mesh = Mesh(np.asarray([device]), (SHARD_AXIS,))
@@ -281,7 +289,8 @@ def kmeans_fit_streamed(path: str, centroids, iters: int = 1,
         flops_per_chunk=flops_per_chunk,
         produce_ms=produce_ms, program="kmeans/stream_step")
     if obs is not None:
-        record_dispatch_batch(obs.registry, B, binfo)
+        record_dispatch_batch(obs.registry, B, binfo,
+                              fresh_probe_ms=produce_ms)
     n_blocks = -(-n_chunks // B)
 
     row = NamedSharding(mesh, P(None, SHARD_AXIS))  # (B, rows, d) blocks
@@ -338,6 +347,10 @@ def kmeans_fit_streamed(path: str, centroids, iters: int = 1,
         return device_put_handoff(buf, row), w_dev, len(group)
 
     c_dev = jax.device_put(centroids, rep)
+    if obs is not None:
+        init_ms = (time.perf_counter() - t_init) * 1e3 - (produce_ms or 0)
+        if init_ms > 0:
+            obs.registry.count("attrib/init_ms", init_ms)
     wait_s = produce_s = 0.0
     t0 = time.perf_counter()
     # ONE stager spans every iteration: data blocks do not depend on the
@@ -349,8 +362,12 @@ def kmeans_fit_streamed(path: str, centroids, iters: int = 1,
     all_groups = chunk_groups(starts, B) * iters
     pf = None
     if pipeline_depth > 1 and len(all_groups) > 1:
+        # obs rides in: the stager live-feeds pipeline/produce_ms and
+        # pipeline/feed_wait_ms per block (the attribution ledger's
+        # feed-wait bucket and the heartbeat's where= token read them
+        # mid-iteration, not at job end)
         pf = BlockStager(all_groups, _stage, depth=pipeline_depth - 1,
-                         name="kmeans/stage")
+                         name="kmeans/stage", obs=obs)
         blocks_it = iter(pf)
     else:
         blocks_it = staged_blocks(all_groups, _stage)
@@ -379,14 +396,30 @@ def kmeans_fit_streamed(path: str, centroids, iters: int = 1,
             it += 1
             if on_iter is not None:
                 # snapshot hook: one extra fetch per iteration, only
-                # when checkpointing asked for it
-                on_iter(it, np.asarray(c_dev))
+                # when checkpointing asked for it.  The fetch blocks on
+                # the whole iteration's device chain — a real
+                # device-compute wait the attribution ledger must see
+                t_fetch = time.perf_counter()
+                c_host = np.asarray(c_dev)
+                if obs is not None:
+                    obs.registry.observe(
+                        "device/compute_ms",
+                        (time.perf_counter() - t_fetch) * 1e3)
+                on_iter(it, c_host)
         else:
             acc = out
     if pf is not None:
         wait_s += pf.wait_s
         produce_s += pf.produce_s
+    t_force = time.perf_counter()
     out = np.asarray(c_dev)  # forces the whole chain
+    if obs is not None:
+        # the force IS the tail of the job's device compute under async
+        # dispatch (the consumer loop runs ahead; the chain materializes
+        # here) — without this observation the attribution ledger would
+        # report the wait as unattributed remainder
+        obs.registry.observe("device/compute_ms",
+                             (time.perf_counter() - t_force) * 1e3)
     if timings is not None:
         timings["feed_s"] = time.perf_counter() - t0
         timings["dispatch_batch"] = B

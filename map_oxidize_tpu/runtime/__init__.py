@@ -45,9 +45,20 @@ def run_job(config: JobConfig, workload: str = "wordcount", on_obs=None):
     ``on_obs`` receives the job's ``Obs`` bundle before the body starts
     (the resident job service's live-status and cancel hookup; see
     :func:`map_oxidize_tpu.runtime.driver.run_wordcount_job`)."""
-    from map_oxidize_tpu.utils.profiling import jax_trace
+    from map_oxidize_tpu.obs.profiler import device_trace
 
-    with jax_trace(config.trace_dir):
+    with device_trace(config.trace_dir):
+        if config.trace_dir:
+            # the whole-job device trace is a profile capture too: it
+            # counts into profile/captures (the metrics doc / ledger
+            # evidence that a deep trace rode this run), recorded as
+            # soon as the job's Obs bundle exists
+            def _on_obs(obs, _orig=on_obs):
+                obs.registry.count("profile/captures")
+                if _orig is not None:
+                    _orig(obs)
+
+            return _run_job(config, workload, _on_obs)
         return _run_job(config, workload, on_obs)
 
 

@@ -258,11 +258,20 @@ def _resolve_auto(program: str, chunk_device_bytes: int,
 
 
 def record_dispatch_batch(registry, b: int, info: dict,
-                          prefix: str = "dispatch") -> None:
+                          prefix: str = "dispatch",
+                          fresh_probe_ms: float | None = None) -> None:
     """Export the decision as flat gauges (``dispatch/batch``,
     ``dispatch/batch_mode``, ``dispatch/<input>`` ...) so it lands in
     ``JobResult.metrics``, the metrics document, and the ledger entry —
-    the record the ISSUE's "auto resolving to a logged B" gate reads."""
+    the record the ISSUE's "auto resolving to a logged B" gate reads.
+
+    ``fresh_probe_ms`` is the wall of a produce probe the CALLER just
+    paid on the critical path (the auto-B fault-in measurement) — it
+    feeds the attribution ledger's ``host_produce`` bucket via the
+    ``attrib/probe_ms`` source counter (distinct from the published
+    bucket gauge, which must never feed back in).  Memoized resolutions
+    carry the ORIGINAL probe figure inside ``info`` but paid nothing
+    this run, so only a caller-declared fresh probe counts."""
     if registry is None:
         return
     registry.set(f"{prefix}/batch", int(b))
@@ -271,3 +280,5 @@ def record_dispatch_batch(registry, b: int, info: dict,
         if k in ("mode", "batch") or v is None:
             continue
         registry.set(f"{prefix}/{k}", v)
+    if fresh_probe_ms is not None and fresh_probe_ms > 0:
+        registry.count("attrib/probe_ms", fresh_probe_ms)

@@ -217,9 +217,20 @@ def _readback(engine: StreamingEngineBase, dictionary: HashDictionary
     """Device accumulator -> :class:`LazyCounts`.  Padding rows carry the
     SENTINEL key and may sit anywhere (engine contract), so mask."""
     hi, lo, vals, n = engine.finalize()
+    # the fetch blocks on the whole accumulated device chain (plus the
+    # D2H copy) — consumer-visible device time the attribution ledger
+    # must see, same as the streamed k-means force.  Timed AFTER
+    # finalize() returns: its own dispatches/compiles are already
+    # measured by the observatory, and jit compiles synchronously at
+    # the call, so this window is pure execution wait + copy
+    t0 = time.perf_counter()
     hi = np.asarray(hi)
     lo = np.asarray(lo)
     vals = np.asarray(vals)
+    obs = getattr(engine, "obs", None)
+    if obs is not None:
+        obs.registry.observe("device/compute_ms",
+                             (time.perf_counter() - t0) * 1e3)
     live = ~((hi == np.uint32(SENTINEL)) & (lo == np.uint32(SENTINEL)))
     k64 = join_u64(hi[live], lo[live])
     if k64.shape[0] != n:
@@ -914,7 +925,10 @@ def _run_kmeans_body(config: JobConfig, obs: Obs,
                 if tk == "overlap_ratio":
                     metrics.set("pipeline/overlap_ratio", tv)
                 elif tk == "feed_wait_s":
-                    metrics.count("pipeline/feed_wait_ms", tv * 1e3)
+                    # already live-fed per block by the stager (the
+                    # attribution bucket feed); counting the total here
+                    # again would double it
+                    pass
                 elif tk == "dispatch_batch":
                     pass  # already recorded as the dispatch/* gauges
                 else:

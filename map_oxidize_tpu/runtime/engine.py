@@ -66,16 +66,36 @@ class CapacityError(RuntimeError):
 
 def pick_device(backend: str = "auto"):
     """Resolve the compute device: 'tpu' demands an accelerator, 'cpu' forces
-    host, 'auto' takes jax's default ordering (accelerator first)."""
-    if backend == "auto":
-        return jax.devices()[0]
-    for d in jax.devices():
-        if d.platform == backend:
-            return d
-    if backend == "cpu":  # cpu backend exists even when an accelerator leads
-        return jax.devices("cpu")[0]
-    raise RuntimeError(f"no {backend!r} device available; have "
-                       f"{[d.platform for d in jax.devices()]}")
+    host, 'auto' takes jax's default ordering (accelerator first).
+
+    The first ``jax.devices()`` of a process INITIALIZES the backend
+    (hundreds of ms on CPU, seconds through a remote attach) — wall the
+    attribution ledger's ``setup`` bucket must see when it lands inside
+    a phase, so the resolve is timed into ``attrib/init_ms`` on the
+    recording job (subsequent calls cost ~0 and add noise-level
+    counts)."""
+    t0 = time.perf_counter()
+    try:
+        if backend == "auto":
+            return jax.devices()[0]
+        for d in jax.devices():
+            if d.platform == backend:
+                return d
+        if backend == "cpu":  # cpu exists even when an accelerator leads
+            return jax.devices("cpu")[0]
+        raise RuntimeError(f"no {backend!r} device available; have "
+                           f"{[d.platform for d in jax.devices()]}")
+    finally:
+        from map_oxidize_tpu.obs.context import current_obs
+
+        obs = current_obs()
+        # only when a phase is open: a pre-phase resolve (the fold
+        # engines' construction path) is already inside the pre-phase
+        # wall the ``attrib/setup_ms`` gauge stamps — counting it again
+        # would double the setup bucket
+        if obs is not None and getattr(obs, "current_phase", None):
+            obs.registry.count("attrib/init_ms",
+                               (time.perf_counter() - t0) * 1e3)
 
 
 def next_pow2(n: int) -> int:

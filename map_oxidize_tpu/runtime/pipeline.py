@@ -74,7 +74,8 @@ class ChunkPrefetcher:
     chunks of host memory until process end.
     """
 
-    def __init__(self, it: Iterable[T], depth: int, name: str = "pipeline"):
+    def __init__(self, it: Iterable[T], depth: int, name: str = "pipeline",
+                 obs=None):
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
         from map_oxidize_tpu.obs.context import bind_current
@@ -84,6 +85,15 @@ class ChunkPrefetcher:
         self._name = name
         self._stop = False
         self._err: BaseException | None = None
+        #: obs bundle for LIVE bucket feeds: with it, every consumed
+        #: item flushes the produce/wait deltas into the registry's
+        #: ``pipeline/produce_ms`` / ``pipeline/feed_wait_ms`` counters
+        #: (the attribution ledger and the heartbeat's where= token read
+        #: them mid-run — end-of-stream totals are identical to the old
+        #: exhaustion-time accounting, the cadence is what changed)
+        self._obs = obs
+        self._reported_produce = 0.0
+        self._reported_wait = 0.0
         # bind-on-spawn: the producer runs the job's host half (read +
         # tokenize/map), and anything it observes — a device-mapper
         # dispatch, a recompile warning — must route to the SPAWNING
@@ -141,6 +151,25 @@ class ChunkPrefetcher:
             return 1.0
         return max(0.0, 1.0 - self.wait_s / self.produce_s)
 
+    def _flush_counters(self, chunks: int = 0) -> None:
+        """Report the produce/wait accumulated since the last flush into
+        the job registry (one locked add per counter per chunk — noise
+        at chunk cadence).  ``produce_s`` is written by the producer
+        thread; a torn read only shifts a delta to the next flush."""
+        if self._obs is None:
+            return
+        reg = self._obs.registry
+        dp = self.produce_s - self._reported_produce
+        dw = self.wait_s - self._reported_wait
+        if dp > 0:
+            self._reported_produce += dp
+            reg.count("pipeline/produce_ms", dp * 1e3)
+        if dw > 0:
+            self._reported_wait += dw
+            reg.count("pipeline/feed_wait_ms", dw * 1e3)
+        if chunks:
+            reg.count("pipeline/chunks", chunks)
+
     def __iter__(self) -> Iterator[T]:
         self._thread.start()
         try:
@@ -153,6 +182,7 @@ class ChunkPrefetcher:
                         raise self._err
                     return
                 self.items += 1
+                self._flush_counters(chunks=1)
                 yield item
         finally:
             # abandon/exhaustion: release the producer if it is still
@@ -163,6 +193,7 @@ class ChunkPrefetcher:
                     self._q.get_nowait()
             except queue.Empty:
                 pass
+            self._flush_counters()
 
 
 def chunk_groups(items: Iterable, batch: int) -> list:
@@ -211,9 +242,9 @@ class BlockStager(ChunkPrefetcher):
     """
 
     def __init__(self, groups: Iterable, stage_fn,
-                 depth: int = 1, name: str = "stager"):
+                 depth: int = 1, name: str = "stager", obs=None):
         super().__init__(staged_blocks(groups, stage_fn),
-                         depth, name=name)
+                         depth, name=name, obs=obs)
 
 
 def pipelined(it: Iterable[T], depth: int, obs=None,
@@ -227,26 +258,25 @@ def pipelined(it: Iterable[T], depth: int, obs=None,
     """
     if depth <= 1:
         return it
-    pf = ChunkPrefetcher(it, depth - 1, name=name)
+    # the prefetcher itself feeds the pipeline/produce_ms and
+    # pipeline/feed_wait_ms counters LIVE per chunk (the attribution
+    # ledger's bucket feeds); totals at exhaustion are identical to the
+    # old end-of-stream accounting
+    pf = ChunkPrefetcher(it, depth - 1, name=name, obs=obs)
 
     def _run():
         try:
             for item in pf:
                 if obs is not None:
                     # live overlap gauge: the time-series recorder and
-                    # /status read it MID-run (the exhaustion-time
-                    # counters below stay the post-hoc record); one
-                    # locked gauge write per chunk is noise at chunk
-                    # cadence
+                    # /status read it MID-run; one locked gauge write
+                    # per chunk is noise at chunk cadence
                     obs.registry.set("pipeline/overlap_ratio",
                                      round(pf.overlap_ratio, 4))
                 yield item
         finally:
             if obs is not None and (pf.items or pf.produce_s):
                 reg = obs.registry
-                reg.count("pipeline/produce_ms", pf.produce_s * 1e3)
-                reg.count("pipeline/feed_wait_ms", pf.wait_s * 1e3)
-                reg.count("pipeline/chunks", pf.items)
                 reg.set("pipeline/depth", depth)
                 reg.set("pipeline/overlap_ratio",
                         round(pf.overlap_ratio, 4))

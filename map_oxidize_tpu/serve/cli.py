@@ -46,6 +46,10 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--ledger-dir", default=d.ledger_dir,
                    help="shared run ledger for every finished job "
                         "(default: <spool>/ledger; 'none' disables)")
+    p.add_argument("--calib-dir", default=d.calib_dir,
+                   help="persistent calibration store shared by every "
+                        "job and across server restarts (default: "
+                        "<spool>/calib; 'none' disables)")
     p.add_argument("--idle-evict-s", type=float, default=d.idle_evict_s,
                    help="close cached corpora idle this long (0 = never)")
     p.add_argument("--drain-timeout-s", type=float,
@@ -81,6 +85,7 @@ def serve_main(argv: list[str]) -> int:
             max_queue=args.max_queue,
             hbm_budget_bytes=args.hbm_budget_bytes,
             spool_dir=args.spool_dir, ledger_dir=args.ledger_dir,
+            calib_dir=args.calib_dir,
             idle_evict_s=args.idle_evict_s,
             drain_timeout_s=args.drain_timeout_s,
             obs_sample_s=args.obs_sample_interval,

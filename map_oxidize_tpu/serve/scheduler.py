@@ -66,7 +66,7 @@ TERMINAL_STATES = frozenset({"done", "failed", "cancelled", "rejected"})
 RESERVED_OVERRIDES = frozenset({
     "input_path", "output_path", "obs_port", "obs_sample_s", "metrics",
     "metrics_out", "crash_dir", "ledger_dir", "progress", "trace_dir",
-    "incident_dir",
+    "incident_dir", "profile_dir", "calib_dir",
     "dist_coordinator", "dist_num_processes", "dist_process_id",
 })
 
@@ -147,6 +147,14 @@ class Scheduler:
         else:
             self.ledger_dir = (cfg.ledger_dir
                                or os.path.join(cfg.spool_dir, "ledger"))
+        # persistent calibration store shared by every job (and by
+        # server restarts — that is the point): each finished job's
+        # measured collective/program costs merge atomically into it
+        if cfg.calib_dir == "none":
+            self.calib_dir = None
+        else:
+            self.calib_dir = (cfg.calib_dir
+                              or os.path.join(cfg.spool_dir, "calib"))
         self._workers = [
             threading.Thread(target=self._worker, daemon=True,
                              name=f"serve-worker-{i}")
@@ -253,7 +261,9 @@ class Scheduler:
             metrics_out=os.path.join(job_dir, "metrics.json"),
             crash_dir=os.path.join(job_dir, "crash"),
             incident_dir=os.path.join(job_dir, "incidents"),
+            profile_dir=os.path.join(job_dir, "profiles"),
             ledger_dir=self.ledger_dir,
+            calib_dir=self.calib_dir,
             progress=False,
         ).validate()                      # ValueError -> caller (HTTP 400)
         est = est_hbm_bytes or estimate_hbm_bytes(config, workload)
@@ -578,6 +588,10 @@ class Scheduler:
                 row["phase"] = hb.phase or row["phase"]
                 row["rows"] = hb.rows
                 row["rows_per_sec"] = round(hb.rows / elapsed, 1)
+                if hb.where is not None:
+                    # the attribution ledger's live one-token answer
+                    # (e.g. "compute 61%"), refreshed per series tick
+                    row["where"] = hb.where
             # live per-job compile evidence (the overlay: activity routed
             # to THIS job, disjoint from concurrent ones)
             from map_oxidize_tpu.obs.compile import job_overlay_delta

@@ -62,6 +62,10 @@ class ResidentServer:
             hbm_sample_s=cfg.obs_sample_s,
             slo_rules=cfg.slo_rules or None,
             incident_dir=os.path.join(cfg.spool_dir, "incidents"),
+            # on-demand POST /profile captures (deep profiling plane)
+            # spool under the server's artifact root — process-wide
+            # captures, so they live beside the jobs, not inside one
+            profile_dir=os.path.join(cfg.spool_dir, "profiles"),
         )
         self.obs = Obs.from_config(self._obs_config)
         self.obs.workload = "serve"

@@ -16,6 +16,8 @@ record format, one obs contract."""
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 from map_oxidize_tpu.shuffle.base import ShuffleTransport
@@ -99,15 +101,29 @@ class DiskPairStage:
         rec = np.empty(n, self.REC)
         rec["k"] = keys[order]
         rec["d"] = docs[order]
+        t0 = time.perf_counter()
         self.files.write_partitioned("kd", rec, counts, offs)
+        self._count_io_ms(t0)
         self.rows += n
         self.bytes += int(rec.nbytes)
         record_spill(self.obs, self._buckets_opened, counts, n,
                      int(rec.nbytes))
 
+    def _count_io_ms(self, t0: float) -> None:
+        """Feed the attribution ledger's ``spill_io`` bucket: wall spent
+        in bucket-file writes/drains (``spill/io_ms``), measured at the
+        call sites so partition/sort compute stays out of it."""
+        if self.obs is not None:
+            self.obs.registry.count(
+                "spill/io_ms", (time.perf_counter() - t0) * 1e3)
+
     def take(self, i: int) -> "np.ndarray | None":
         """Drain bucket ``i`` (read + unlink); None if never written."""
-        return self.files.take("kd", i, self.REC)
+        t0 = time.perf_counter()
+        try:
+            return self.files.take("kd", i, self.REC)
+        finally:
+            self._count_io_ms(t0)
 
     def drain_csr(self, sort_pairs):
         """Bucket-by-bucket CSR finalize — THE shared drain (the
@@ -143,7 +159,9 @@ class DiskPairStage:
                     else np.empty(0, np.int64))
                 terms_parts.append(keys[bounds])
                 df_parts.append(np.diff(np.append(bounds, keys.shape[0])))
+                t0 = time.perf_counter()
                 out.write(docs.tobytes())
+                self._count_io_ms(t0)
         holder = self.release()  # caller keeps the doc file alive
         if not terms_parts:
             return (np.empty(0, np.uint64), np.zeros(1, np.int64),

@@ -4,31 +4,17 @@ The flat 61-line ``Metrics`` dict that lived here is subsumed by
 :class:`map_oxidize_tpu.obs.metrics.MetricsRegistry` (counters, gauges,
 histograms, memory watermarks) and the span tracer in
 :mod:`map_oxidize_tpu.obs.trace`; this module keeps the old import path
-alive (``Metrics`` is the registry) plus the ``jax.profiler`` deep-dive
-toggle, which is orthogonal to the framework-level event model — it
-captures XLA's own device timeline, ours captures the host-side
-pipeline.  See docs/OBSERVABILITY.md.
+alive (``Metrics`` is the registry).  The ``jax.profiler`` deep-dive
+toggle that also lived here is retired onto the deep-profiling plane —
+:func:`map_oxidize_tpu.obs.profiler.device_trace` is the ONE
+implementation (shared with on-demand ``POST /profile`` captures, which
+detect and defer to an active whole-job trace); ``jax_trace`` stays as
+a thin alias for old importers.  See docs/OBSERVABILITY.md.
 """
 
 from __future__ import annotations
 
-import contextlib
-
 from map_oxidize_tpu.obs.metrics import MetricsRegistry as Metrics
+from map_oxidize_tpu.obs.profiler import device_trace as jax_trace
 
 __all__ = ["Metrics", "jax_trace"]
-
-
-@contextlib.contextmanager
-def jax_trace(log_dir: str | None):
-    """Optional jax.profiler trace around a region (real-hardware deep dive)."""
-    if not log_dir:
-        yield
-        return
-    import jax
-
-    jax.profiler.start_trace(log_dir)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()

@@ -245,6 +245,7 @@ JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python -m map_oxidize_tpu wordcount "$smoke/corpus_live.txt" \
     --output "$smoke/out_live.txt" --num-shards 8 --num-chunks 48 \
     --batch-size 512 --quiet --obs-port 0 \
+    --calib-dir "$smoke/calib" \
     --metrics-out "$smoke/metrics_live.json" > /dev/null &
 live_job=$!
 python - "$smoke" <<'EOF'
@@ -340,6 +341,7 @@ JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     --batch-size 512 --quiet --obs-port 0 --obs-sample-interval 0.05 \
     --slo-rules "$smoke/slo_rules.json" \
     --incident-dir "$smoke/incidents" \
+    --calib-dir "$smoke/calib" \
     --metrics-out "$smoke/metrics_alert.json" > /dev/null &
 alert_job=$!
 trap 'kill "$alert_job" 2>/dev/null; rm -rf "$smoke"' EXIT
@@ -399,6 +401,46 @@ assert inc["status"]["schema"] == "moxt-status-v1"
 print("alert smoke OK: fired -> resolved, incident bundle landed")
 EOF
 
+echo "== attribution + calibration smoke =="
+# (1) the wall-clock attribution ledger must decompose BOTH acceptance
+# smokes — the 8-shard wordcount and the scan-batched streamed k-means
+# — to >= 90% of measured wall (remainder reported, never hidden);
+# (2) the two --calib-dir wordcount runs above (live + alert smokes)
+# must have merged into ONE calibration store with nonzero
+# per-collective bandwidth rows keyed (collective, program, shape-bucket)
+python - "$smoke" <<'EOF'
+import json, sys
+d = sys.argv[1]
+for name, path in (("wordcount", f"{d}/metrics_live.json"),
+                   ("kmeans", f"{d}/kmetrics.json")):
+    a = json.load(open(path))["attrib"]
+    assert a["schema"] == "moxt-attrib-v1", a
+    total = sum(b["ms"] for b in a["buckets"].values())
+    assert abs(total + a["unattributed_ms"] - a["wall_ms"]) \
+        <= 0.03 * a["wall_ms"], a
+    assert a["unattributed_pct"] < 10.0, (
+        f"{name}: {a['unattributed_pct']}% of wall unattributed "
+        f"(buckets must cover >= 90%): {a['buckets']}")
+    print(f"attrib OK ({name}): {100 - a['unattributed_pct']:.1f}% of "
+          f"{a['wall_ms'] / 1e3:.2f}s wall attributed")
+store = json.load(open(f"{d}/calib/calib.json"))
+assert store["schema"] == "moxt-calib-v1" and store["runs"] >= 2, store
+from map_oxidize_tpu.obs.calib import CalibStore
+bw = [r for r in CalibStore(doc=store).bandwidth_table()
+      if r["collective"] == "all_to_all" and r.get("gbytes_per_s")]
+assert bw, "no nonzero all_to_all bandwidth row in the merged store"
+r = bw[0]
+assert r["runs"] >= 2, r   # BOTH runs' samples merged into the row
+print(f"calib OK: {store['runs']} runs merged; {r['collective']}/"
+      f"{r['program']} @ {r['shape_bucket']}: {r['gbytes_per_s']} GB/s "
+      f"over {r['calls']} calls")
+EOF
+# the CLI reports must render from the same artifacts (sed drains the
+# pipe, so the renderer never dies on EPIPE under pipefail)
+python -m map_oxidize_tpu obs where "$smoke/metrics_live.json" \
+    | sed -n '1,6p'
+python -m map_oxidize_tpu obs calib "$smoke/calib" | sed -n '1,6p'
+
 echo "== serve smoke =="
 # resident job server on an ephemeral port: 3 identical small wordcounts
 # back to back must show compile/* deltas of ZERO after job 1 (the warm-
@@ -433,7 +475,35 @@ ids = [c.submit("wordcount", f"{d}/corpus.txt", config=cfg,
 tbl = c.jobs()
 assert tbl["schema"] == "moxt-jobs-v1", tbl
 assert len(tbl["jobs"]) == 3 and tbl["queue"]["max"] == 16
+# mid-run deep capture on the LIVE resident server: a host-sampling
+# POST /profile while the worker is still chewing the queue — it must
+# produce stacks WITHOUT aborting the jobs.  The device leg is taken
+# separately below, after the queue drains: jax.profiler's stop_trace
+# serializes every event since start, and capturing THROUGH a
+# concurrent cold compile costs minutes on this backend (measured) —
+# the host sampler is the right mid-run tool, the device trace the
+# right warm-server one
+import json, os, urllib.request
+def profile(body, timeout):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/profile",
+        data=json.dumps(body).encode(), method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+prof = profile({"duration_s": 1.0, "host_sample_hz": 60,
+                "device": False, "label": "mid-run"}, 60)
+assert prof["schema"] == "moxt-profile-v1", prof
+assert prof["host_samples"] > 0, prof
+assert os.path.isfile(prof["host_stacks"]), prof
+assert prof["dir"].startswith(f"{d}/serve_spool/profiles"), prof
+assert prof["meta"]["running_jobs"], "capture saw no running jobs"
 docs = [c.wait(i, timeout_s=120) for i in ids]
+# device+host capture on the still-live warm server (first jax.profiler
+# start/stop pays ~10s of init+serialization here — timeout generous)
+prof2 = profile({"duration_s": 0.5, "host_sample_hz": 60}, 240)
+assert prof2["device"].get("dir") and os.listdir(prof2["device"]["dir"]), \
+    f"device trace artifacts missing: {prof2['device']}"
+assert os.path.isfile(prof2["host_stacks"]), prof2
 assert [x["state"] for x in docs] == ["done"] * 3, docs
 assert docs[0]["compiles"] >= 1, docs[0]      # cold job compiled
 assert docs[1]["compiles"] == 0, docs[1]      # warm: zero deltas
@@ -446,4 +516,7 @@ EOF
 wait "$serve_job"   # exit 0 = clean drain on the client's shutdown
 trap 'rm -rf "$smoke"' EXIT
 unset MOXT_OBS_PORT_FILE
+# the flame report renders from the capture the smoke just took
+python -m map_oxidize_tpu obs flame "$smoke/serve_spool/profiles" \
+    | sed -n '1,8p'
 echo "check.sh: ALL OK"

@@ -1,0 +1,451 @@
+"""Deep profiling plane (ISSUE-11 tentpole): the wall-clock attribution
+ledger, on-demand /profile captures, the host sampling profiler, and the
+persistent cross-run calibration store.
+
+The attribution tests drive a REAL slowed CPU job (the same harness
+tests/test_obs_live.py uses) so the buckets carry live wall, then pin
+the decomposition identity: buckets + unattributed == wall, nothing
+negative, the remainder honest.  The store tests exercise the
+round-trip, the cross-run merge, and BOTH refusal modes (schema version
+and a row whose identity disagrees with its key).
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from map_oxidize_tpu.config import JobConfig
+from map_oxidize_tpu.obs import attrib, calib, profiler
+
+
+def _write_corpus(path, lines: int = 400) -> None:
+    rng = np.random.default_rng(7)
+    with open(path, "w") as f:
+        for _ in range(lines):
+            f.write(" ".join(f"w{i}" for i in
+                             rng.integers(0, 60, 8)) + "\n")
+
+
+class _SlowMapper:
+    """Delegating mapper that sleeps per chunk (in the prefetch thread,
+    so the consumer's stall is REAL feed-wait)."""
+
+    def __init__(self, inner, delay_s: float):
+        self._inner = inner
+        self._delay = delay_s
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def map_chunk(self, chunk):
+        time.sleep(self._delay)
+        return self._inner.map_chunk(chunk)
+
+
+@pytest.fixture(scope="module")
+def slowed_job(tmp_path_factory):
+    """One slowed wordcount with the live plane + /profile server on:
+    returns the final metrics document, the obs URL scrapes captured
+    mid-run, and the /profile outcomes."""
+    from map_oxidize_tpu.runtime.driver import run_wordcount_job
+    from map_oxidize_tpu.workloads.wordcount import make_wordcount
+
+    tmp = tmp_path_factory.mktemp("attrib")
+    corpus = tmp / "c.txt"
+    _write_corpus(corpus, lines=2000)
+    mapper, reducer = make_wordcount("ascii", use_native=False)
+    cfg = JobConfig(
+        input_path=str(corpus), output_path="", metrics=False,
+        num_chunks=10, batch_size=1 << 12, num_map_workers=1,
+        mapper="python", use_native=False,
+        obs_port=0, obs_sample_s=0.03,
+        profile_dir=str(tmp / "profiles"),
+        metrics_out=str(tmp / "metrics.json"),
+    )
+    portfile = tmp / "ports.txt"
+    os.environ["MOXT_OBS_PORT_FILE"] = str(portfile)
+    box: dict = {}
+
+    def _run():
+        try:
+            box["result"] = run_wordcount_job(
+                cfg, _SlowMapper(mapper, 0.2), reducer)
+        except BaseException as e:  # pragma: no cover - surfaced below
+            box["error"] = e
+
+    t = threading.Thread(target=_run)
+    t.start()
+    try:
+        deadline = time.monotonic() + 60
+        while not portfile.exists() and time.monotonic() < deadline:
+            time.sleep(0.005)
+        port = int(portfile.read_text().split()[1])
+        url = f"http://127.0.0.1:{port}"
+        status = None
+        while time.monotonic() < deadline:
+            with urllib.request.urlopen(url + "/status", timeout=5) as r:
+                status = json.loads(r.read())
+            if (status.get("phase") == "map+reduce"
+                    and status.get("attrib")):
+                break
+            time.sleep(0.01)
+        box["mid_status"] = status
+        # concurrent /profile: exactly one capture runs, the loser 409s
+        body = json.dumps({"duration_s": 0.5, "host_sample_hz": 60,
+                           "device": False}).encode()
+        codes: list = []
+        docs: list = []
+
+        def _post():
+            req = urllib.request.Request(url + "/profile", data=body,
+                                         method="POST")
+            try:
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    codes.append(resp.getcode())
+                    docs.append(json.loads(resp.read()))
+            except urllib.error.HTTPError as e:
+                codes.append(e.code)
+
+        t1 = threading.Thread(target=_post)
+        t2 = threading.Thread(target=_post)
+        t1.start()
+        time.sleep(0.1)
+        t2.start()
+        t1.join()
+        t2.join()
+        box["profile_codes"] = sorted(codes)
+        box["profile_docs"] = docs
+    finally:
+        t.join(timeout=120)
+        os.environ.pop("MOXT_OBS_PORT_FILE", None)
+    if "error" in box:
+        raise box["error"]
+    with open(tmp / "metrics.json") as f:
+        box["metrics"] = json.load(f)
+    box["tmp"] = tmp
+    return box
+
+
+# --- the attribution ledger -------------------------------------------------
+
+
+def test_buckets_sum_to_wall_within_tolerance(slowed_job):
+    """The decomposition identity on a real job: every bucket >= 0,
+    buckets + unattributed == wall (to rounding), and on this slowed
+    pipelined run the buckets cover >= 80% of the wall with feed_wait
+    the dominant bucket (the injected sleep runs in the prefetch
+    thread — its visible residue IS the consumer stall)."""
+    doc = slowed_job["metrics"]["attrib"]
+    assert doc["schema"] == "moxt-attrib-v1"
+    total = 0.0
+    for name, row in doc["buckets"].items():
+        assert row["ms"] >= 0.0, f"negative bucket {name}: {row}"
+        total += row["ms"]
+    assert total == pytest.approx(doc["attributed_ms"], abs=1.0)
+    # buckets are measured on independent clocks (perf_counter sums vs
+    # the unix wall), so the identity holds to a small relative bound,
+    # not exactly — the remainder clamps at zero when sums run slightly
+    # hot
+    assert (doc["attributed_ms"] + doc["unattributed_ms"]
+            == pytest.approx(doc["wall_ms"], rel=0.03))
+    assert doc["unattributed_pct"] <= 20.0, doc
+    # the ~0.2s x 10 chunks of injected producer sleep is visible wall,
+    # and it dominates every bucket except the cold-process ones
+    # (compile/setup depend on whether an earlier test in this process
+    # already warmed the jit caches — not this test's business)
+    assert doc["buckets"]["feed_wait"]["ms"] > 1000.0
+    steady = {k: v["ms"] for k, v in doc["buckets"].items()
+              if k not in ("compile", "setup")}
+    assert max(steady, key=steady.get) == "feed_wait", doc["buckets"]
+
+
+def test_attrib_flat_gauges_and_live_status(slowed_job):
+    """The flat attrib/* gauges ride the metrics doc (ledger/BENCH
+    evidence), and the MID-RUN /status carried a live decomposition."""
+    gauges = slowed_job["metrics"]["gauges"]
+    assert "attrib/unattributed_pct" in gauges
+    assert gauges["attrib/feed_wait_ms"] > 0
+    live = slowed_job["mid_status"]["attrib"]
+    assert live["schema"] == "moxt-attrib-v1"
+    assert live["wall_ms"] < slowed_job["metrics"]["attrib"]["wall_ms"]
+
+
+def test_where_token_and_heartbeat_line():
+    """where_token picks the dominant bucket; a heartbeat with .where
+    set appends it to the line."""
+    from map_oxidize_tpu.obs.heartbeat import Heartbeat
+
+    doc = {"unattributed_pct": 5.0,
+           "buckets": {"device_compute": {"ms": 610.0, "pct": 61.0},
+                       "feed_wait": {"ms": 340.0, "pct": 34.0}}}
+    assert attrib.where_token(doc) == "compute 61%"
+    lines = []
+    hb = Heartbeat(interval_s=1.0, clock=lambda: 0.0,
+                   emit=lines.append)
+    hb.where = "compute 61%"
+    hb.final_beat()
+    assert "where=compute 61%" in lines[0]
+
+
+def test_unattributed_gate_fires_on_injected_hole():
+    """obs diff --gate flags an unattributed-fraction regression: a
+    +10-point hole flags, jitter below the floor does not."""
+    from map_oxidize_tpu.obs import ledger
+
+    def entry(pct):
+        return {"workload": "wc", "config_hash": "x", "version": "1",
+                "corpus_bytes": 10, "phases_s": {},
+                "metrics": {"attrib/unattributed_pct": pct}}
+
+    diff = ledger.diff_entries(entry(4.0), entry(40.0), force=True)
+    assert any("unattributed" in r for r in diff["regressions"]), diff
+    diff = ledger.diff_entries(entry(4.0), entry(9.0), force=True)
+    assert not diff["regressions"], diff
+
+
+def test_where_cli_renders(slowed_job, capsys):
+    from map_oxidize_tpu.obs.cli import obs_main
+
+    rc = obs_main(["where", str(slowed_job["tmp"] / "metrics.json")])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "feed_wait" in out and "unattributed" in out
+
+
+# --- on-demand deep profiling ----------------------------------------------
+
+
+def test_profile_concurrent_capture_409(slowed_job):
+    """Exactly one of two concurrent POST /profile requests captures;
+    the other gets 409 (single-capture mutex)."""
+    assert slowed_job["profile_codes"] == [200, 409]
+    doc = slowed_job["profile_docs"][0]
+    assert doc["schema"] == "moxt-profile-v1"
+    assert doc["host_samples"] > 0
+    assert os.path.isfile(doc["host_stacks"])
+    # the capture counted into the job's registry
+    assert slowed_job["metrics"]["counters"]["profile/captures"] == 1
+    # and carried a live attribution snapshot
+    assert doc["attrib"]["schema"] == "moxt-attrib-v1"
+
+
+def test_host_sampler_sees_known_hot_thread():
+    """The sampling profiler produces stacks naming a function we KNOW
+    is hot (a spinning thread)."""
+    stop = threading.Event()
+
+    def _known_hot_spin():
+        while not stop.is_set():
+            sum(i * i for i in range(500))
+
+    t = threading.Thread(target=_known_hot_spin, name="hot-spin")
+    t.start()
+    try:
+        sampler = profiler.HostSampler(hz=200)
+        sampler.start()
+        time.sleep(0.4)
+        sampler.stop()
+    finally:
+        stop.set()
+        t.join()
+    text = sampler.collapsed()
+    assert sampler.samples > 10
+    assert "_known_hot_spin" in text, text[:500]
+    # flame report parses and classifies it
+    report = profiler.flame_report(text, top=5)
+    assert "_known_hot_spin" in report or "hot-spin" in report
+
+
+def test_capture_duration_bounds(tmp_path):
+    with pytest.raises(ValueError):
+        profiler.capture(str(tmp_path), duration_s=0.0, device=False)
+    with pytest.raises(ValueError):
+        profiler.capture(str(tmp_path),
+                         duration_s=profiler.MAX_CAPTURE_S + 1,
+                         device=False)
+
+
+def test_jax_trace_alias_is_profiler_device_trace():
+    """Satellite: utils.profiling.jax_trace is the profiler's
+    device_trace — one implementation."""
+    from map_oxidize_tpu.utils import profiling
+
+    assert profiling.jax_trace is profiler.device_trace
+
+
+# --- the calibration store --------------------------------------------------
+
+
+def _fake_comms_rows():
+    return [
+        {"collective": "all_to_all", "program": "shuffle/merge",
+         "shape": "8x1024", "count": 10, "bytes": 10 * (1 << 20),
+         "latency_ms": {"count": 4, "mean": 2.5, "p50": 2.4,
+                        "p95": 3.0, "max": 3.2}},
+        {"collective": "psum", "program": "kmeans/stream_step",
+         "shape": "4x9", "count": 20, "bytes": 20 * 144,
+         "latency_ms": None},
+    ]
+
+
+def _fake_xprof():
+    return {"programs": {
+        "kmeans/stream_step": {"dispatches": 8, "dispatch_ms": 12.0,
+                               "sampled_device_ms": 30.0,
+                               "device_samples": 2, "compiles": 1,
+                               "compile_ms": 400.0}}}
+
+
+def test_calib_round_trip_and_two_run_merge(tmp_path):
+    """Two runs merge into ONE store: counts accumulate, the bandwidth
+    table shows a nonzero per-collective GB/s row keyed by
+    (collective, program, shape-bucket)."""
+    path = str(tmp_path / "calib.json")
+    ident = {"platform": "cpu", "device_count": 8, "topology": "1x8"}
+    for _run in range(2):
+        store = calib.CalibStore(path=path)
+        assert store.accumulate_run(ident, _fake_comms_rows(),
+                                    _fake_xprof()) == 3
+        store.save_merged()
+    merged = calib.CalibStore.load(path)
+    assert merged.doc["runs"] == 2
+    key = "cpu|8|1x8|all_to_all|shuffle/merge|1MB"
+    row = merged.doc["comms"][key]
+    assert row["calls"] == 20 and row["runs"] == 2
+    assert row["bytes"] == 20 * (1 << 20)
+    bw = [r for r in merged.bandwidth_table()
+          if r["collective"] == "all_to_all"]
+    assert bw and bw[0]["gbytes_per_s"] > 0
+    assert bw[0]["shape_bucket"] == "1MB"
+    prog = merged.doc["programs"]["cpu|8|1x8|kmeans/stream_step"]
+    assert prog["dispatches"] == 16 and prog["compiles"] == 2
+    # render is non-empty and names the collective
+    text = calib.render(merged)
+    assert "all_to_all" in text and "1MB" in text
+
+
+def test_calib_refuses_version_mismatch(tmp_path):
+    path = str(tmp_path / "calib.json")
+    store = calib.CalibStore(path=path)
+    store.accumulate_run({"platform": "cpu", "device_count": 1,
+                          "topology": "1x1"}, _fake_comms_rows(), None)
+    store.save_merged()
+    doc = json.load(open(path))
+    doc["version"] = 99
+    json.dump(doc, open(path, "w"))
+    with pytest.raises(calib.CalibMismatch, match="version"):
+        calib.CalibStore.load(path)
+    # a new run's merge refuses too (and leaves the file intact)
+    run = calib.CalibStore(path=path)
+    run.accumulate_run({"platform": "cpu", "device_count": 1,
+                        "topology": "1x1"}, _fake_comms_rows(), None)
+    with pytest.raises(calib.CalibMismatch):
+        run.save_merged()
+    assert json.load(open(path))["version"] == 99  # untouched
+
+
+def test_calib_refuses_topology_identity_mismatch(tmp_path):
+    """A row whose stored identity disagrees with its key (a doctored/
+    torn store) refuses the merge."""
+    path = str(tmp_path / "calib.json")
+    store = calib.CalibStore(path=path)
+    store.accumulate_run({"platform": "cpu", "device_count": 2,
+                          "topology": "1x2"}, _fake_comms_rows(), None)
+    store.save_merged()
+    doc = json.load(open(path))
+    key = next(iter(doc["comms"]))
+    doc["comms"][key]["topology"] = "2x8"  # disagrees with the key
+    json.dump(doc, open(path, "w"))
+    with pytest.raises(calib.CalibMismatch, match="topology"):
+        calib.CalibStore.load(path)
+
+
+def test_calib_shape_buckets():
+    assert calib.shape_bucket(0) == "0B"
+    assert calib.shape_bucket(100) == "64B"
+    assert calib.shape_bucket(1 << 20) == "1MB"
+    assert calib.shape_bucket((1 << 20) + 5) == "1MB"
+    assert calib.shape_bucket((1 << 21) - 1) == "1MB"
+    assert calib.shape_bucket(1 << 21) == "2MB"
+
+
+def test_calib_obs_wiring_end_to_end(tmp_path):
+    """Two real CPU jobs with --calib-dir produce one merged store whose
+    program rows accumulate across the runs."""
+    from map_oxidize_tpu.runtime import run_job
+
+    corpus = tmp_path / "c.txt"
+    _write_corpus(corpus, lines=100)
+    for i in range(2):
+        cfg = JobConfig(
+            input_path=str(corpus), output_path="", metrics=False,
+            num_chunks=4, batch_size=1 << 12, num_shards=1,
+            calib_dir=str(tmp_path / "calib"),
+        ).validate()
+        r = run_job(cfg, "wordcount")
+        assert r.metrics.get("calib/runs") == i + 1
+    store = calib.CalibStore.load(str(tmp_path / "calib"))
+    assert store.doc["runs"] == 2
+    rows = [v for v in store.doc["programs"].values()
+            if v["program"] == "engine/merge_packed"]
+    assert rows and rows[0]["runs"] == 2
+    # the jit cache is process-global (an earlier test in the same
+    # process may already have warmed this program), so compiles only
+    # bound above; dispatches from BOTH runs accumulate either way
+    assert rows[0]["compiles"] <= 1
+    assert rows[0]["dispatches"] >= 2
+
+
+# --- trend satellite: MULTICHIP rounds --------------------------------------
+
+
+def test_trend_loads_multichip_rounds(tmp_path):
+    """obs trend --bench accepts MULTICHIP_r*.json beside BENCH_r*.json;
+    the two families trend as separate groups and an ok 1 -> 0 flip
+    ranks as a regression mover."""
+    from map_oxidize_tpu.obs import trend
+
+    paths = []
+    for i, ok in enumerate([True, True, False], 1):
+        p = tmp_path / f"MULTICHIP_r{i:02d}.json"
+        p.write_text(json.dumps({"n_devices": 8, "rc": 0 if ok else 1,
+                                 "ok": ok, "skipped": False,
+                                 "tail": "dryrun"}))
+        paths.append(str(p))
+    b = tmp_path / "BENCH_r01.json"
+    b.write_text(json.dumps({"parsed": {"value": 1.0, "vs_baseline": 5.0,
+                                        "workloads": {"wc": 5.0}}}))
+    paths.append(str(b))
+    entries = trend.bench_rounds(paths)
+    kinds = {e["workload"] for e in entries}
+    assert kinds == {"multichip-rounds", "bench-rounds"}
+    multi = [e for e in entries if e["workload"] == "multichip-rounds"]
+    assert len(multi) == 3
+    assert multi[0]["metrics"] == {"n_devices": 8, "rc": 0, "ok": 1,
+                                   "skipped": 0}
+    movers = trend.movers(multi)
+    ok_mv = [m for m in movers if m["name"] == "ok"]
+    assert ok_mv and ok_mv[0]["direction"] == "regressed"
+    rc_mv = [m for m in movers if m["name"] == "rc"]
+    assert rc_mv and rc_mv[0]["direction"] == "new"
+
+
+def test_trend_cli_multichip_groups(tmp_path, capsys):
+    from map_oxidize_tpu.obs.cli import obs_main
+
+    for i, ok in enumerate([True, False], 1):
+        (tmp_path / f"MULTICHIP_r{i:02d}.json").write_text(
+            json.dumps({"n_devices": 8, "rc": 0 if ok else 1, "ok": ok,
+                        "skipped": False}))
+    rc = obs_main(["trend", "--bench",
+                   str(tmp_path / "MULTICHIP_r*.json")])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "multichip-rounds" in out
+    assert "ok" in out
