@@ -8,6 +8,7 @@ directory containing ``shakes.txt``.  Usage here:
     python -m map_oxidize_tpu bigram corpus.txt --backend tpu
     python -m map_oxidize_tpu obs merge trace.json     # shard merge
     python -m map_oxidize_tpu obs diff --ledger-dir runs/  # regression diff
+    python -m map_oxidize_tpu obs fleet --spool spool/ # fleet observatory
     python -m map_oxidize_tpu serve --port 8321        # resident job server
     python -m map_oxidize_tpu submit --url http://127.0.0.1:8321 \\
         wordcount corpus.txt --wait                    # enqueue a job
@@ -210,6 +211,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "histogram quantile (metrics doc `series` "
                         "section + /series endpoint); 0 = off unless "
                         "--obs-port is set (then 1s)")
+    p.add_argument("--obs-spool", default=None,
+                   help="fleet-discovery spool: where the live obs "
+                        "server publishes its port record so `obs "
+                        "fleet` finds this job without flags (default: "
+                        "$MOXT_OBS_SPOOL or a well-known per-user "
+                        "tempdir; 'none' disables publishing)")
     p.add_argument("--slo-rules", default=None,
                    help="SLO/alerting rule set for the live plane: a "
                         "JSON file path or inline JSON (a list extends "
@@ -278,6 +285,7 @@ def config_from_args(args: argparse.Namespace) -> JobConfig:
         stall_warn_factor=args.stall_factor,
         obs_port=args.obs_port,
         obs_sample_s=args.obs_sample_interval,
+        obs_spool=args.obs_spool,
         slo_rules=args.slo_rules,
         incident_dir=args.incident_dir,
         profile_dir=args.profile_dir,

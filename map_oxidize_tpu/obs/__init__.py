@@ -64,6 +64,19 @@ The watcher on top of the live plane (ISSUE-9):
   trajectories, step-change detection, and the ranked movers report
   that attributes a gate failure to the counters that moved.
 
+The layer above any one process (ISSUE-13):
+
+* :mod:`~map_oxidize_tpu.obs.fleet` — the fleet observatory
+  (``obs fleet``): a collector polling N obs endpoints (explicit
+  targets, port files, serve spools, and the well-known port-record
+  spool every serving process publishes into), merging them into one
+  fleet model with staleness tracking, per-target labeled ``/metrics``
+  + fleet aggregates (the multi-server load index), cross-target
+  incident correlation at ``/alerts``, fleet-scope SLO rules through
+  the same ``SloEvaluator``, and a bounded on-disk series archive that
+  ``obs trend/top/where --archive`` read after every producer process
+  has exited.
+
 See ``docs/OBSERVABILITY.md`` for the event model and flag reference.
 """
 

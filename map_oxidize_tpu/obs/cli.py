@@ -1,6 +1,6 @@
 """``python -m map_oxidize_tpu obs ...`` — observability artifact tools.
 
-Eight subcommands, all pure host-side work (no jax, no backend init):
+Nine subcommands, all pure host-side work (no jax, no backend init):
 
 * ``obs merge`` — combine a distributed run's per-process trace shards
   (``<trace_out>.proc<i>``) into one Chrome trace (pid = process slot)
@@ -35,6 +35,14 @@ Eight subcommands, all pure host-side work (no jax, no backend init):
   (``--calib-dir``): per-collective bandwidth curves keyed (platform,
   devices, topology, collective, program, shape-bucket) plus the
   per-program dispatch/compute table accumulated across runs.
+* ``obs fleet`` — the fleet observatory
+  (:mod:`map_oxidize_tpu.obs.fleet`): a collector daemon polling any
+  number of obs endpoints (``--targets``, a port file, resident-server
+  spool dirs, and the well-known port-record spool), merging them into
+  one fleet model, serving fleet ``/metrics`` (per-target labels +
+  aggregates) / ``/status`` / ``/alerts`` (cross-target incident
+  correlation), and optionally archiving the fleet series to a bounded
+  on-disk ring (``--archive-dir``).
 * ``obs top`` — live terminal view of a running job: polls the
   ``--obs-port`` server's ``/status`` and redraws phase, rows/sec, ETA,
   the compile/MFU table, HBM, the attribution panel, and the comms
@@ -43,7 +51,13 @@ Eight subcommands, all pure host-side work (no jax, no backend init):
   SLO plane's ``/alerts`` panel (firing + recently-resolved) when the
   evaluator is running, and — pointed at a RESIDENT job server
   (``python -m map_oxidize_tpu serve``) — the ``/jobs`` table next to
-  the single-job view.
+  the single-job view.  Pointed at a FLEET collector it renders the
+  per-target table + incident panel instead; ``--archive`` renders the
+  last archived fleet frame post-mortem.
+
+``obs trend --archive`` and ``obs where --archive`` read the fleet
+archive the same way — trajectories and per-target attribution survive
+every producer process exiting.
 """
 
 from __future__ import annotations
@@ -127,6 +141,11 @@ def build_obs_parser() -> argparse.ArgumentParser:
     tr.add_argument("--bench", nargs="*", default=[], metavar="JSON",
                     help="BENCH_r*.json round artifacts to trend instead "
                          "of (or besides) a ledger")
+    tr.add_argument("--archive", default=None, metavar="DIR",
+                    help="a fleet series archive (obs fleet "
+                         "--archive-dir): trend the archived fleet "
+                         "samples — the history that survives every "
+                         "producer process exiting")
     tr.add_argument("--threshold-pct", type=float, default=25.0,
                     help="step-change detection threshold (default 25)")
     tr.add_argument("--top", type=int, default=10,
@@ -150,6 +169,15 @@ def build_obs_parser() -> argparse.ArgumentParser:
                    help="a LIVE job/server obs URL (e.g. "
                         "http://127.0.0.1:8321): render the current "
                         "/status attribution instead of a document")
+    w.add_argument("--archive", default=None, metavar="DIR",
+                   help="a fleet series archive: render the attribution "
+                        "of the last archived per-target /status "
+                        "snapshots (post-mortem — works after every "
+                        "target process exited)")
+    w.add_argument("--target", default=None,
+                   help="with --archive: only this target label "
+                        "(host:port); default: every target that "
+                        "carried an attribution")
     w.add_argument("--json", action="store_true",
                    help="emit the structured attribution document")
 
@@ -176,12 +204,67 @@ def build_obs_parser() -> argparse.ArgumentParser:
     cb.add_argument("--json", action="store_true",
                     help="emit the raw store document")
 
+    fle = sub.add_parser(
+        "fleet", help="fleet observatory: poll N obs endpoints, merge "
+                      "them into one fleet model, serve fleet /metrics "
+                      "(per-target labels + aggregates) /status /alerts "
+                      "(cross-target incidents), and archive the fleet "
+                      "series to a bounded on-disk ring")
+    fle.add_argument("--targets", nargs="*", default=[], metavar="URL",
+                     help="explicit endpoints (http://host:port or "
+                          "host:port); explicit targets never depart "
+                          "the model")
+    fle.add_argument("--port-file", default="",
+                     help="a MOXT_OBS_PORT_FILE-format file "
+                          "('<process> <port>' lines) to derive "
+                          "127.0.0.1 targets from")
+    fle.add_argument("--spool", nargs="*", default=[], metavar="DIR",
+                     dest="spool_dirs",
+                     help="resident-server spool dirs: each one's "
+                          "obs_port.json names a target")
+    fle.add_argument("--discover-dir", default="",
+                     help="well-known port-record spool to scan for "
+                          "live processes (default: $MOXT_OBS_SPOOL or "
+                          "the per-user tempdir spool; 'none' disables "
+                          "auto-discovery)")
+    fle.add_argument("--port", type=int, default=0,
+                     help="the collector's own HTTP port (0 = "
+                          "ephemeral, logged and written to "
+                          "MOXT_OBS_PORT_FILE as 'fleet <port>')")
+    fle.add_argument("--host", default="127.0.0.1")
+    fle.add_argument("--interval", type=float, default=1.0,
+                     help="seconds between scrape sweeps (default 1)")
+    fle.add_argument("--stale-after", type=float, default=30.0,
+                     help="a target unreachable/refusing this long is "
+                          "marked stale and fires the fleet staleness "
+                          "alert (default 30s)")
+    fle.add_argument("--archive-dir", default=None,
+                     help="persistent fleet series archive "
+                          "(moxt-archive-v1 ring-of-segments; read "
+                          "post-mortem with obs trend/top/where "
+                          "--archive)")
+    fle.add_argument("--archive-segment-records", type=int, default=512,
+                     help="archive ring: samples per segment file")
+    fle.add_argument("--archive-max-segments", type=int, default=16,
+                     help="archive ring: segments kept (oldest pruned)")
+    fle.add_argument("--slo-rules", default=None,
+                     help="fleet SLO rule set (JSON file path or inline "
+                          "JSON; defaults: target staleness, per-target "
+                          "HBM watermark fraction, scrape refusals)")
+    fle.add_argument("--iterations", type=int, default=0,
+                     help="stop after N scrape sweeps (0 = run until "
+                          "SIGTERM/Ctrl-C — the normal daemon mode)")
+
     t = sub.add_parser(
         "top", help="live terminal view of a running job: poll the "
                     "--obs-port server's /status and redraw")
-    t.add_argument("--url", required=True,
+    t.add_argument("--url", default=None,
                    help="the job's obs server, e.g. http://127.0.0.1:8321 "
                         "(the [obs] serving log line prints it)")
+    t.add_argument("--archive", default=None, metavar="DIR",
+                   help="render the last archived fleet frame from an "
+                        "obs fleet --archive-dir instead of polling a "
+                        "live server (post-mortem view)")
     t.add_argument("--interval", type=float, default=2.0,
                    help="seconds between polls (default 2)")
     t.add_argument("--iterations", type=int, default=0,
@@ -209,7 +292,52 @@ def obs_main(argv: list[str]) -> int:
         return _flame(args)
     if args.cmd == "calib":
         return _calib(args)
+    if args.cmd == "fleet":
+        return _fleet(args)
     return _diff(args)
+
+
+def _fleet(args) -> int:
+    from map_oxidize_tpu.config import FleetConfig
+    from map_oxidize_tpu.obs.fleet import FleetCollector, FleetServer
+
+    try:
+        cfg = FleetConfig(
+            targets=list(args.targets), port_file=args.port_file,
+            spool_dirs=list(args.spool_dirs),
+            discover_dir=args.discover_dir,
+            host=args.host, port=args.port,
+            poll_interval_s=args.interval,
+            stale_after_s=args.stale_after,
+            archive_dir=args.archive_dir,
+            archive_segment_records=args.archive_segment_records,
+            archive_max_segments=args.archive_max_segments,
+            slo_rules=args.slo_rules,
+        ).validate()
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    collector = FleetCollector(cfg)
+    server = FleetServer(collector, cfg.port, host=cfg.host).start()
+    print(f"[fleet] collector on {server.url} "
+          f"(/metrics /status /alerts /series; watch with "
+          f"obs top --url {server.url})", flush=True)
+    try:
+        if args.iterations:
+            for _ in range(args.iterations):
+                collector.poll_once()
+                import time as _time
+
+                _time.sleep(cfg.poll_interval_s)
+        else:
+            collector.start()
+            collector._thread.join()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        collector.stop()
+        server.stop()
+    return 0
 
 
 def _where(args) -> int:
@@ -217,6 +345,39 @@ def _where(args) -> int:
 
     from map_oxidize_tpu.obs.attrib import render
 
+    if args.archive:
+        # post-mortem: the archived per-target /status snapshots carry
+        # each target's last live attribution — readable after every
+        # producer process exited
+        from map_oxidize_tpu.obs.fleet import ArchiveMismatch, SeriesArchive
+
+        try:
+            snap = SeriesArchive.latest(args.archive, "targets")
+        except ArchiveMismatch as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        targets = (snap or {}).get("targets") or {}
+        if args.target is not None:
+            targets = {k: v for k, v in targets.items()
+                       if k == args.target}
+        with_attrib = {label: st for label, st in sorted(targets.items())
+                       if isinstance(st, dict) and st.get("attrib")}
+        if not with_attrib:
+            print("error: no archived target attribution"
+                  + (f" for {args.target!r}" if args.target else "")
+                  + f" under {args.archive!r}", file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps({label: st["attrib"]
+                              for label, st in with_attrib.items()},
+                             indent=1, sort_keys=True))
+            return 0
+        for label, st in with_attrib.items():
+            wl = (st.get("meta") or {}).get("workload")
+            print(render(st["attrib"],
+                         title=f"where did the time go — {label} "
+                               f"({wl or '?'}, archived)"))
+        return 0
     if args.url:
         import urllib.request
 
@@ -245,8 +406,8 @@ def _where(args) -> int:
         wl = (mdoc.get("meta") or {}).get("workload")
         title = f"where did the time go — {wl or '?'}"
     else:
-        print("error: obs where needs a metrics document or --url",
-              file=sys.stderr)
+        print("error: obs where needs a metrics document, --url, or "
+              "--archive", file=sys.stderr)
         return 2
     if not doc:
         print("error: no attrib section (produced by a pre-attribution "
@@ -475,6 +636,20 @@ def _trend(args) -> int:
     from map_oxidize_tpu.obs import ledger, trend
 
     groups: list[tuple[str, list]] = []
+    if args.archive:
+        from map_oxidize_tpu.obs.fleet import ArchiveMismatch
+
+        try:
+            entries = trend.archive_entries(args.archive, last=args.last)
+        except (ArchiveMismatch, OSError) as e:
+            print(f"error: cannot read fleet archive: {e}",
+                  file=sys.stderr)
+            return 2
+        if len(entries) >= 2:
+            groups.append(("fleet-archive", entries))
+        else:
+            print(f"(fleet archive: only {len(entries)} sample — need "
+                  ">= 2 to trend)")
     if args.bench:
         paths: list[str] = []
         for spec in args.bench:
@@ -530,9 +705,10 @@ def _trend(args) -> int:
             else:
                 print(f"(workload {wl!r}: only {len(es)} entry — need "
                       ">= 2 to trend)")
-    if not groups and not args.bench and not args.ledger_dir:
-        print("error: obs trend needs --ledger-dir and/or --bench files",
-              file=sys.stderr)
+    if not groups and not args.bench and not args.ledger_dir \
+            and not args.archive:
+        print("error: obs trend needs --ledger-dir, --bench files, "
+              "and/or --archive", file=sys.stderr)
         return 2
     if not groups:
         return 2
@@ -683,12 +859,106 @@ def render_jobs(doc: dict) -> str:
     return "\n".join(lines)
 
 
+def render_fleet(doc: dict) -> str:
+    """A ``moxt-fleet-status-v1`` document as an ``obs top`` frame: the
+    per-target table (state, phase, rows/sec, HBM, queue, firing alerts,
+    staleness) plus the fleet aggregates.  Pure, so tests pin the
+    rendering without a collector."""
+    counts = doc.get("counts") or {}
+    agg = doc.get("aggregates") or {}
+    head = (f"moxt obs fleet — {counts.get('targets', 0)} targets "
+            f"({counts.get('up', 0)} up, {counts.get('stale', 0)} stale"
+            + (f", {counts['departed']} departed"
+               if counts.get("departed") else "")
+            + f")  uptime={doc.get('uptime_s', 0):.0f}s")
+    lines = [head]
+    lines.append(
+        f"fleet: {agg.get('rows_per_sec', 0):,.0f} rows/s, "
+        f"hbm max {_fmt_bytes(int(agg.get('hbm_max_bytes', 0) or 0))}, "
+        f"queue {agg.get('queue_depth', 0):g}, "
+        f"{agg.get('jobs_running', 0):g} running, "
+        f"{agg.get('target_alerts_firing', 0):g} target alerts firing")
+    targets = doc.get("targets") or []
+    if targets:
+        lines.append(
+            f"  {'target':<21} {'state':<8} {'kind':<6} {'phase':<14} "
+            f"{'rows/s':>9} {'hbm':>9} {'queue':>5} {'alerts':>6} "
+            f"{'stale s':>7}")
+        for t in targets[:16]:
+            stale_s = t.get("staleness_s") or 0
+            lines.append(
+                f"  {t['target']:<21} {t['state']:<8} "
+                f"{t.get('kind', '?'):<6} "
+                f"{(t.get('phase') or '-'):<14} "
+                f"{t.get('rows_per_sec', 0):>9,.0f} "
+                f"{_fmt_bytes(int(t.get('hbm_bytes') or 0)):>9} "
+                f"{t.get('queue_depth', 0):>5g} "
+                f"{t.get('alerts_firing', 0):>6g} "
+                f"{(f'{stale_s:.0f}' if stale_s else '-'):>7}")
+    arch = doc.get("archive")
+    if arch:
+        lines.append(f"archive: {arch['dir']} "
+                     f"({arch['segments']} segments, cap "
+                     f"{arch['max_records']} samples)")
+    return "\n".join(lines)
+
+
+def render_fleet_alerts(doc: dict) -> str:
+    """A ``moxt-fleet-alerts-v1`` document as an ``obs top`` panel: the
+    correlated incidents (one row per rule, naming every target) plus
+    the collector's own firing set."""
+    incidents = doc.get("incidents") or []
+    fleet = doc.get("fleet") or {}
+    counts = fleet.get("counts") or {}
+    lines = [f"fleet alerts: {len([i for i in incidents if i['active']])}"
+             f" active incidents (collector lifetime "
+             f"{counts.get('fired', 0)} fired / "
+             f"{counts.get('resolved', 0)} resolved)"]
+    for inc in incidents[:8]:
+        mark = "!!" if inc.get("active") else "ok"
+        lines.append(
+            f"  {mark} {inc.get('severity', '?').upper():<8} "
+            f"{inc['rule']}: {inc['k']} target(s) — "
+            f"{', '.join(inc['targets'][:6])}"
+            + ("" if inc.get("active") else " (resolved)"))
+    return "\n".join(lines)
+
+
+def _top_archive(args) -> int:
+    """``obs top --archive``: the last archived fleet frame, rendered
+    once — the post-mortem view after every process exited."""
+    from map_oxidize_tpu.obs.fleet import ArchiveMismatch, SeriesArchive
+
+    try:
+        status = SeriesArchive.latest(args.archive, "status")
+        alerts = SeriesArchive.latest(args.archive, "alerts")
+    except ArchiveMismatch as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if not status:
+        print(f"error: no archived fleet status under {args.archive!r}",
+              file=sys.stderr)
+        return 2
+    frame = render_fleet(status)
+    if alerts and alerts.get("schema") == "moxt-fleet-alerts-v1":
+        frame += "\n" + render_fleet_alerts(alerts)
+    print(frame)
+    print(f"(archived frame as of t={status.get('t_unix_s')})")
+    return 0
+
+
 def _top(args) -> int:
     import json
     import time
     import urllib.error
     import urllib.request
 
+    if args.archive:
+        return _top_archive(args)
+    if not args.url:
+        print("error: obs top needs --url (live) or --archive "
+              "(post-mortem)", file=sys.stderr)
+        return 2
     base = args.url.rstrip("/")
     url = base + "/status"
     polls = 0
@@ -707,27 +977,33 @@ def _top(args) -> int:
                 print(f"error: cannot reach {url}: {e}", file=sys.stderr)
                 return 2
             seen_one = True
-            frame = render_status(doc)
+            fleet_schema = doc.get("schema") == "moxt-fleet-status-v1"
+            frame = render_fleet(doc) if fleet_schema \
+                else render_status(doc)
             # the SLO plane's panel rides beside the job view (servers
-            # without an evaluator 404 here — skip silently)
+            # without an evaluator 404 here — skip silently); a fleet
+            # collector serves the correlated-incident form instead
             try:
                 with urllib.request.urlopen(base + "/alerts",
                                             timeout=5) as resp:
                     alerts_doc = json.loads(resp.read())
-                if alerts_doc.get("schema") == "moxt-alerts-v1":
+                if alerts_doc.get("schema") == "moxt-fleet-alerts-v1":
+                    frame += "\n" + render_fleet_alerts(alerts_doc)
+                elif alerts_doc.get("schema") == "moxt-alerts-v1":
                     frame += "\n" + render_alerts(alerts_doc)
             except (urllib.error.URLError, OSError, ValueError):
                 pass
             # a resident job server carries /jobs too: render the table
             # (plain per-job telemetry servers 404 here — skip silently)
-            try:
-                with urllib.request.urlopen(base + "/jobs",
-                                            timeout=5) as resp:
-                    jobs_doc = json.loads(resp.read())
-                if jobs_doc.get("schema") == "moxt-jobs-v1":
-                    frame += "\n" + render_jobs(jobs_doc)
-            except (urllib.error.URLError, OSError, ValueError):
-                pass
+            if not fleet_schema:
+                try:
+                    with urllib.request.urlopen(base + "/jobs",
+                                                timeout=5) as resp:
+                        jobs_doc = json.loads(resp.read())
+                    if jobs_doc.get("schema") == "moxt-jobs-v1":
+                        frame += "\n" + render_jobs(jobs_doc)
+                except (urllib.error.URLError, OSError, ValueError):
+                    pass
             if args.no_clear:
                 print(frame)
                 print("-" * 40)

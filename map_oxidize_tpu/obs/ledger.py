@@ -38,7 +38,7 @@ _NON_IDENTITY_FIELDS = frozenset({
     "trace_dir", "trace_out", "metrics_out", "metrics", "progress",
     "progress_interval_s", "ledger_dir", "crash_dir",
     "hbm_sample_s", "stall_warn_factor",
-    "obs_port", "obs_sample_s",
+    "obs_port", "obs_sample_s", "obs_spool",
     "slo_rules", "incident_dir",
     "calib_dir", "profile_dir", "host_sample_hz",
     "dist_coordinator", "dist_process_id",

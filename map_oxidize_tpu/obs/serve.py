@@ -20,7 +20,11 @@ shut down cleanly by ``Obs.finish`` *and* the flight recorder:
   (:mod:`map_oxidize_tpu.obs.timeseries`) as aligned value lists;
 * ``GET /alerts``  — the SLO plane (:mod:`map_oxidize_tpu.obs.slo`):
   firing and recently-resolved alerts, per-rule state, and the bounded
-  transition timeline (``moxt-alerts-v1``).
+  transition timeline (``moxt-alerts-v1``);
+* ``GET /healthz`` — the cheap liveness probe (``moxt-healthz-v1``:
+  version, uptime, phase, job counts) the fleet collector
+  (:mod:`map_oxidize_tpu.obs.fleet`) and the future front-door router
+  poll without paying the full ``/status`` render.
 
 When a resident job service (:mod:`map_oxidize_tpu.serve`) attaches its
 scheduler, the SAME server additionally exposes the job plane — one
@@ -66,8 +70,55 @@ from map_oxidize_tpu.utils.logging import get_logger
 _log = get_logger(__name__)
 
 STATUS_SCHEMA = "moxt-status-v1"
+HEALTHZ_SCHEMA = "moxt-healthz-v1"
+PORT_RECORD_SCHEMA = "moxt-obs-port-v1"
 
 _PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def default_obs_spool() -> str | None:
+    """The well-known port-record spool the fleet collector
+    (:mod:`map_oxidize_tpu.obs.fleet`) scans when no targets are given:
+    ``$MOXT_OBS_SPOOL`` if set (``none`` disables publishing), else a
+    per-user directory under the system tempdir — stable across
+    processes, so a 2-process Gloo run and the ``obs fleet`` watching it
+    agree on the location without any flag."""
+    env = os.environ.get("MOXT_OBS_SPOOL")
+    if env:
+        return None if env == "none" else env
+    import tempfile
+
+    uid = getattr(os, "getuid", lambda: 0)()
+    return os.path.join(tempfile.gettempdir(), f"moxt-obs-spool-{uid}")
+
+
+def build_healthz(srv) -> dict:
+    """``GET /healthz``: the cheap liveness document — version, uptime,
+    phase, and job counts, with NONE of the ``/status`` render (no xprof
+    join, no attribution pass, no comms table).  This is what the fleet
+    collector and the future front-door router probe at their poll
+    cadence; the full ``/status`` stays the on-demand deep read."""
+    from map_oxidize_tpu import __version__
+
+    obs = srv.obs
+    now = time.time()
+    phase = getattr(obs, "current_phase", None)
+    hb = getattr(obs, "heartbeat", None)
+    if hb is not None and hb.phase:
+        phase = hb.phase
+    doc = {
+        "schema": HEALTHZ_SCHEMA,
+        "version": __version__,
+        "t_unix_s": round(now, 3),
+        "uptime_s": round(max(now - obs.tracer.wall_start, 0.0), 3),
+        "phase": phase,
+        "workload": getattr(obs, "workload", None),
+        "process": obs.process,
+        "n_processes": obs.n_processes,
+    }
+    if srv.scheduler is not None:
+        doc["jobs"] = srv.scheduler.health_doc()
+    return doc
 
 
 def sanitize_metric_name(name: str) -> str:
@@ -317,12 +368,14 @@ class _Handler(BaseHTTPRequestHandler):
         srv = self.server
         path = self.path.split("?", 1)[0]
         try:
-            if path in ("/", "/healthz"):
-                eps = ["/metrics", "/status", "/series", "/alerts",
-                       "POST /profile"]
+            if path == "/":
+                eps = ["/healthz", "/metrics", "/status", "/series",
+                       "/alerts", "POST /profile"]
                 if srv.scheduler is not None:
                     eps += ["/jobs", "/jobs/<id>"]
                 self._json({"endpoints": eps, "schema": STATUS_SCHEMA})
+            elif path == "/healthz":
+                self._json(build_healthz(srv))
             elif path == "/alerts":
                 ev = getattr(srv.obs, "alerts", None)
                 if ev is None:
@@ -534,6 +587,7 @@ class ObsServer:
             target=self._httpd.serve_forever, daemon=True,
             name="obs-serve")
         self._stopped = False
+        self._spool_record: str | None = None
 
     def start(self) -> None:
         self._thread.start()
@@ -555,6 +609,42 @@ class ObsServer:
             except OSError as e:  # discovery is best-effort
                 _log.warning("cannot write MOXT_OBS_PORT_FILE %s: %s",
                              portfile, e)
+        self._publish_spool_record()
+
+    def _publish_spool_record(self) -> None:
+        """Drop a ``moxt-obs-port-v1`` record in the well-known spool so
+        ``obs fleet`` discovers this process with no flags: every process
+        of a distributed run publishes its own slot, so a 2-process Gloo
+        job appears as two targets.  Removed on clean :meth:`stop`; a
+        killed process leaves its record behind with a dead pid, which is
+        exactly how the collector tells "exited" from "died" (dead-pid
+        records it never watched are garbage-collected at discovery)."""
+        spool = (getattr(self._httpd.config, "obs_spool", None)
+                 or default_obs_spool())
+        if not spool or spool == "none":
+            return
+        obs = self._httpd.obs
+        path = os.path.join(
+            spool, f"moxt-obs-{os.getpid()}-p{obs.process}.json")
+        try:
+            from map_oxidize_tpu import __version__
+            from map_oxidize_tpu.obs import write_json_atomic
+
+            os.makedirs(spool, exist_ok=True)
+            write_json_atomic(path, {
+                "schema": PORT_RECORD_SCHEMA,
+                "version": __version__,
+                "pid": os.getpid(),
+                "process": obs.process,
+                "n_processes": obs.n_processes,
+                "host": self.host,
+                "port": self.port,
+                "url": self.url,
+                "started_unix_s": round(time.time(), 3),
+            })
+            self._spool_record = path
+        except OSError as e:  # discovery is best-effort
+            _log.debug("cannot publish obs port record %s: %s", path, e)
 
     def stop(self) -> None:
         """Idempotent clean shutdown (called by ``Obs.finish`` AND the
@@ -562,6 +652,11 @@ class ObsServer:
         if self._stopped:
             return
         self._stopped = True
+        if self._spool_record:
+            try:
+                os.unlink(self._spool_record)
+            except OSError:
+                pass
         try:
             self._httpd.shutdown()
             self._httpd.server_close()

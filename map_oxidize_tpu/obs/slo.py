@@ -69,7 +69,10 @@ TIMELINE_CAP = 128
 _KINDS = ("value", "delta", "rate")
 _OPS = (">", ">=", "<", "<=")
 _SEVERITIES = ("warning", "critical")
-_SCOPES = ("any", "job", "serve")
+#: "fleet" arms only on a fleet collector's evaluator
+#: (:mod:`map_oxidize_tpu.obs.fleet`), whose merged cross-target series
+#: no single job or server ever records
+_SCOPES = ("any", "job", "serve", "fleet")
 
 _RULE_FIELDS = frozenset({
     "name", "metric", "kind", "op", "threshold", "window_s", "for_s",
@@ -216,12 +219,17 @@ DEFAULT_RULES: tuple[dict, ...] = (
 )
 
 
-def load_rules(spec: str | None) -> list[SloRule]:
+def load_rules(spec: str | None,
+               defaults: tuple[dict, ...] = DEFAULT_RULES
+               ) -> list[SloRule]:
     """Resolve ``--slo-rules`` into the rule set.  ``spec`` may be None/
     empty (defaults only), a path to a JSON file, or inline JSON.  A
     JSON list EXTENDS the defaults; ``{"defaults": false,
     "rules": [...]}`` replaces them.  A later rule with an existing name
-    overrides the earlier one (so defaults are tunable by name)."""
+    overrides the earlier one (so defaults are tunable by name).
+    ``defaults`` is the built-in set ``{"defaults": true}`` refers to —
+    :data:`DEFAULT_RULES` for jobs/servers, the fleet collector passes
+    its own :data:`~map_oxidize_tpu.obs.fleet.FLEET_RULES`."""
     parsed = None
     if spec:
         text = spec.strip()
@@ -242,7 +250,7 @@ def load_rules(spec: str | None) -> list[SloRule]:
     elif parsed is not None:
         raise ValueError("--slo-rules JSON must be a list of rules or "
                          'an object with a "rules" list')
-    raw = (list(DEFAULT_RULES) if use_defaults else []) + extra
+    raw = (list(defaults) if use_defaults else []) + extra
     by_name: dict[str, SloRule] = {}
     for d in raw:
         if not isinstance(d, dict):
@@ -252,7 +260,12 @@ def load_rules(spec: str | None) -> list[SloRule]:
             raise ValueError(
                 f"unknown SLO rule field(s) {sorted(unknown)} in "
                 f"{d.get('name', d)!r}")
-        rule = SloRule(**d).validate()
+        try:
+            rule = SloRule(**d)
+        except TypeError as e:  # a missing required field must surface
+            # as the config-time ValueError every caller catches
+            raise ValueError(f"bad SLO rule {d!r}: {e}") from e
+        rule.validate()
         by_name[rule.name] = rule      # later wins: defaults are tunable
     return list(by_name.values())
 
@@ -321,10 +334,11 @@ class SloEvaluator:
     @property
     def _scope(self) -> str:
         """This evaluator's plane: the resident server's own bundle
-        (workload 'serve') evaluates serve-scoped rules; everything else
-        is a job."""
-        return "serve" if getattr(self.obs, "workload", None) == "serve" \
-            else "job"
+        (workload 'serve') evaluates serve-scoped rules, a fleet
+        collector's (workload 'fleet') the fleet-scoped ones; everything
+        else is a job."""
+        wl = getattr(self.obs, "workload", None)
+        return wl if wl in ("serve", "fleet") else "job"
 
     def evaluate_once(self, now: float | None = None) -> list[dict]:
         """One tick: run every armed rule against the ring, advance the

@@ -51,7 +51,7 @@ class TimeSeriesRecorder:
 
     def __init__(self, registry, interval_s: float = 1.0,
                  capacity: int = DEFAULT_CAPACITY, clock=time.time,
-                 heartbeat=None, obs=None):
+                 heartbeat=None, obs=None, on_sample=None):
         if interval_s <= 0:
             raise ValueError("interval_s must be positive")
         if capacity <= 0:
@@ -68,6 +68,12 @@ class TimeSeriesRecorder:
         self.interval_s = interval_s
         self.capacity = capacity
         self._clock = clock
+        #: optional tap called with each ``(unix_ts, {name: value})``
+        #: sample right after it lands in the ring (outside the lock) —
+        #: the fleet collector's series archive appends exactly what was
+        #: sampled, including the final stop() sample.  A tap error is
+        #: swallowed: persistence must never stop telemetry sampling
+        self.on_sample = on_sample
         #: ring of (unix_ts, {name: value}) snapshots; _head is the next
         #: write slot once the ring has wrapped
         self._ring: list = []
@@ -155,6 +161,11 @@ class TimeSeriesRecorder:
                 self._ring[self._head] = sample
                 self._head = (self._head + 1) % self.capacity
             self.samples_taken += 1
+        if self.on_sample is not None:
+            try:
+                self.on_sample(sample[0], sample[1])
+            except Exception:  # persistence must never stop sampling
+                pass
 
     # --- export -----------------------------------------------------------
 

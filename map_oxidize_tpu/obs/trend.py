@@ -230,6 +230,31 @@ def bench_rounds(paths: list[str]) -> list[dict]:
     return entries
 
 
+def archive_entries(root: str, last: int = 0) -> list[dict]:
+    """Adapt a fleet series archive (``--archive-dir``,
+    ``moxt-archive-v1`` — :class:`map_oxidize_tpu.obs.fleet.
+    SeriesArchive`) into ledger-shaped entries, one per archived sample,
+    so the whole analysis path (trajectories, steps, movers) reads fleet
+    history that OUTLIVES every producer process — the post-mortem no
+    longer depends on the process that died having flushed its metrics
+    document.  ``last`` keeps only the newest N samples (0 = all)."""
+    from map_oxidize_tpu.obs.fleet import SeriesArchive
+
+    samples = SeriesArchive.samples(root)
+    if last and last > 1:
+        samples = samples[-last:]
+    entries = []
+    for ts, values in samples:
+        entries.append({
+            "workload": "fleet-archive",
+            "ts_unix_s": ts,
+            "phases_s": {},
+            "metrics": {k: v for k, v in values.items()
+                        if _numeric(v)},
+        })
+    return entries
+
+
 def _ts_label(entry: dict) -> str:
     import time as _time
 

@@ -64,7 +64,8 @@ TERMINAL_STATES = frozenset({"done", "failed", "cancelled", "rejected"})
 #: or does not serve (multi-process jobs have their own launcher) —
 #: submission overrides naming one are a malformed request
 RESERVED_OVERRIDES = frozenset({
-    "input_path", "output_path", "obs_port", "obs_sample_s", "metrics",
+    "input_path", "output_path", "obs_port", "obs_sample_s", "obs_spool",
+    "metrics",
     "metrics_out", "crash_dir", "ledger_dir", "progress", "trace_dir",
     "incident_dir", "profile_dir", "calib_dir",
     "dist_coordinator", "dist_num_processes", "dist_process_id",
@@ -517,6 +518,20 @@ class Scheduler:
             time.sleep(0.05)
 
     # --- documents (the /jobs endpoints) ----------------------------------
+
+    def health_doc(self) -> dict:
+        """The job-plane slice of ``GET /healthz``: counts only, no
+        per-job row rendering — cheap enough for a fleet collector or
+        router to poll every tick."""
+        with self._cond:
+            return {
+                "running": len(self._running),
+                "queued": len(self._queue),
+                "queue_depth": len(self._queue),
+                "max_queue": self.cfg.max_queue,
+                "workers": self.cfg.workers,
+                "draining": self._draining,
+            }
 
     def jobs_doc(self) -> dict:
         now = time.time()

@@ -83,6 +83,7 @@ class ResidentServer:
 
     def start(self) -> "ResidentServer":
         self.http.start()
+        self._publish_port_record()
         self.scheduler.start()
         # warm the backend off the serving path: the resident server
         # exists to pay jax/mesh init once, and the HBM admission budget
@@ -121,6 +122,36 @@ class ResidentServer:
             except Exception as e:  # pragma: no cover - defensive
                 _log.debug("budget gauge publish failed: %s", e)
 
+    def _publish_port_record(self) -> None:
+        """Write ``<spool>/obs_port.json`` (``moxt-obs-port-v1``) so a
+        fleet collector pointed at the spool (``obs fleet --spool``)
+        finds this server's bound port without flags.  Removed on clean
+        shutdown; a killed server leaves it behind, which is how the
+        collector tells "exited" (record gone -> target departed) from
+        "died" (record present, endpoint dead -> stale + fleet alert)."""
+        from map_oxidize_tpu import __version__
+        from map_oxidize_tpu.obs import write_json_atomic
+        from map_oxidize_tpu.obs.serve import PORT_RECORD_SCHEMA
+
+        path = os.path.join(self.cfg.spool_dir, "obs_port.json")
+        try:
+            os.makedirs(self.cfg.spool_dir, exist_ok=True)
+            write_json_atomic(path, {
+                "schema": PORT_RECORD_SCHEMA,
+                "version": __version__,
+                "pid": os.getpid(),
+                "kind": "serve",
+                "host": self.http.host,
+                "port": self.http.port,
+                "url": self.http.url,
+                "started_unix_s": round(self.scheduler.started_at, 3),
+            })
+            self._port_record = path
+        except OSError as e:  # discovery is best-effort
+            _log.warning("cannot publish serve port record %s: %s",
+                         path, e)
+            self._port_record = None
+
     @property
     def url(self) -> str:
         return self.http.url
@@ -139,6 +170,11 @@ class ResidentServer:
             return
         self.scheduler.shutdown(drain=drain)
         self.obs.finish(self._obs_config, "serve")
+        if getattr(self, "_port_record", None):
+            try:
+                os.unlink(self._port_record)
+            except OSError:
+                pass
         self._stopped.set()
         _log.info("[serve] resident job server stopped")
 

@@ -519,4 +519,135 @@ unset MOXT_OBS_PORT_FILE
 # the flame report renders from the capture the smoke just took
 python -m map_oxidize_tpu obs flame "$smoke/serve_spool/profiles" \
     | sed -n '1,8p'
+
+echo "== fleet observatory smoke =="
+# two resident servers on ephemeral ports + one fleet collector watching
+# both spools: submitted jobs must surface as per-target labels AND a
+# nonzero fleet-aggregate row rate on the collector's /metrics; killing
+# one server (-9, so its spool record survives) must fire the staleness
+# alert in the fleet /alerts timeline; and after EVERY process is gone,
+# obs trend/top must reconstruct the run purely from --archive-dir
+export MOXT_OBS_PORT_FILE="$smoke/fleet_port.txt"
+rm -f "$smoke/fleet_port.txt"
+for s in A B; do
+    JAX_PLATFORMS=cpu MOXT_OBS_PORT_FILE= python -m map_oxidize_tpu \
+        serve --port 0 --workers 1 \
+        --spool-dir "$smoke/fleet_spool_$s" --quiet &
+    eval "fleet_srv_$s=\$!"
+    eval "echo \$fleet_srv_$s > '$smoke/fleet_srv_$s.pid'"
+done
+JAX_PLATFORMS=cpu python -m map_oxidize_tpu obs fleet \
+    --spool "$smoke/fleet_spool_A" "$smoke/fleet_spool_B" \
+    --discover-dir none --interval 0.2 --stale-after 2 \
+    --archive-dir "$smoke/fleet_archive" > "$smoke/fleet.log" &
+fleet_col=$!
+trap 'kill -9 "$fleet_col" "$fleet_srv_A" "$fleet_srv_B" 2>/dev/null; rm -rf "$smoke"' EXIT
+python - "$smoke" <<'EOF'
+import json, os, sys, time, urllib.request
+d = sys.argv[1]
+deadline = time.monotonic() + 180
+
+def wait_port(path, key=None):
+    while time.monotonic() < deadline:
+        try:
+            if key is None:
+                return int(open(path).read().split()[1])
+            return int(json.loads(open(path).read())[key])
+        except (OSError, IndexError, ValueError, KeyError):
+            time.sleep(0.02)
+    raise AssertionError(f"port never appeared at {path}")
+
+ports = {s: wait_port(f"{d}/fleet_spool_{s}/obs_port.json", "port")
+         for s in "AB"}
+fleet_port = wait_port(f"{d}/fleet_port.txt")
+fleet = f"http://127.0.0.1:{fleet_port}"
+
+def get(base, ep):
+    return urllib.request.urlopen(base + ep, timeout=5).read()
+
+# both targets must come up in the fleet model before work is submitted
+labels = {s: f"127.0.0.1:{ports[s]}" for s in "AB"}
+while time.monotonic() < deadline:
+    st = json.loads(get(fleet, "/status"))
+    assert st["schema"] == "moxt-fleet-status-v1", st
+    if st["counts"]["up"] == 2:
+        break
+    time.sleep(0.05)
+assert st["counts"]["up"] == 2, f"fleet never saw both servers: {st}"
+
+# submit one small wordcount to EACH server
+from map_oxidize_tpu.serve.client import ServeClient
+cfg = {"num_chunks": 8, "batch_size": 64, "num_shards": 1}
+for s in "AB":
+    c = ServeClient(f"http://127.0.0.1:{ports[s]}")
+    doc = c.submit("wordcount", f"{d}/corpus.txt", config=cfg,
+                   output=f"{d}/fleet_out_{s}.txt")
+    c.wait(doc["id"], timeout_s=120)
+
+# the fleet /metrics must carry BOTH targets' labels and a nonzero
+# aggregate row rate (recently-finished jobs count toward the load
+# index for a bounded window)
+rate = 0.0
+while time.monotonic() < deadline:
+    prom = get(fleet, "/metrics").decode()
+    have_labels = all(f'{{target="{labels[s]}"}}' in prom for s in "AB")
+    for line in prom.splitlines():
+        if line.startswith("moxt_fleet_rows_per_sec "):
+            rate = float(line.rsplit(" ", 1)[1])
+    if have_labels and rate > 0:
+        break
+    time.sleep(0.1)
+assert have_labels, "fleet /metrics lacks a target label"
+assert rate > 0, "fleet-aggregate row rate never went nonzero"
+print(f"fleet scrape OK: both targets labeled, fleet rate {rate} rows/s")
+
+# kill server A hard: its spool record survives, so the fleet must mark
+# it STALE and fire the staleness alert into the /alerts timeline
+os.kill(int(open(f"{d}/fleet_srv_A.pid").read()), 9)
+fired = False
+while time.monotonic() < deadline and not fired:
+    al = json.loads(get(fleet, "/alerts"))
+    assert al["schema"] == "moxt-fleet-alerts-v1", al
+    fired = any(e["event"] == "fired"
+                and e["rule"] == "fleet-target-stale"
+                and labels["A"] in e["series"]
+                for e in al["fleet"]["timeline"])
+    time.sleep(0.1)
+assert fired, "staleness alert never fired after killing server A"
+inc = [i for i in al["incidents"] if i["rule"] == "fleet-target-stale"]
+assert inc and labels["A"] in inc[0]["targets"], al["incidents"]
+st = json.loads(get(fleet, "/status"))
+row = [t for t in st["targets"] if t["target"] == labels["A"]][0]
+assert row["state"] == "stale", row
+print("fleet staleness OK: kill -> stale row + fired alert + incident")
+
+# drain server B cleanly, then the post-mortem readers take over
+ServeClient(f"http://127.0.0.1:{ports['B']}").shutdown(drain=True)
+EOF
+wait "$fleet_srv_A" 2>/dev/null || true   # reap the killed server
+wait "$fleet_srv_B"   # exit 0 = clean drain
+kill "$fleet_col" 2>/dev/null || true
+wait "$fleet_col" 2>/dev/null || true
+trap 'rm -rf "$smoke"' EXIT
+unset MOXT_OBS_PORT_FILE
+# every producer AND the collector are gone: the archive alone must
+# reconstruct the run — trajectories and the final fleet frame
+python -m map_oxidize_tpu obs trend --archive "$smoke/fleet_archive" \
+    | sed -n '1,8p'
+python -m map_oxidize_tpu obs top --archive "$smoke/fleet_archive" \
+    | sed -n '1,6p'
+python - "$smoke" <<'EOF'
+import sys
+from map_oxidize_tpu.obs.fleet import SeriesArchive
+d = sys.argv[1]
+export = SeriesArchive.export(f"{d}/fleet_archive")
+rates = [v for v in export["series"].get("fleet/rows_per_sec", []) if v]
+assert rates, "archive never recorded a nonzero fleet rate"
+stale = export["series"].get("fleet/targets_stale") or []
+assert any(v == 1 for v in stale), "archive never recorded the staleness"
+st = SeriesArchive.latest(f"{d}/fleet_archive", "status")
+assert st and st["schema"] == "moxt-fleet-status-v1"
+print(f"fleet archive OK: {len(export['t_unix_s'])} samples, "
+      f"peak rate {max(rates)} rows/s, staleness recorded")
+EOF
 echo "check.sh: ALL OK"
