@@ -54,13 +54,18 @@ def build_parser() -> argparse.ArgumentParser:
 
     p.add_argument("--version", action="version",
                    version=f"%(prog)s {__version__}")
-    p.add_argument("workload",
-                   choices=["wordcount", "bigram", "invertedindex", "kmeans",
-                            "distinct"],
+    # single source of truth: the same tuple the serve scheduler and the
+    # submit CLI consume — a workload added to config.WORKLOADS appears
+    # in every allowlist at once (tests assert they agree)
+    from map_oxidize_tpu.config import WORKLOADS
+
+    p.add_argument("workload", choices=list(WORKLOADS),
                    help="built-in workload to run")
     p.add_argument("input", help="input path: text corpus (reference: "
-                                 "shakes.txt), or a .npy points file for "
-                                 "kmeans")
+                                 "shakes.txt), a .npy points file for "
+                                 "kmeans, or a .npy (u64 key, u64 "
+                                 "payload) records file for "
+                                 "sort/join/sessionize")
     p.add_argument("--output", default="final_result.txt",
                    help="final result path (reference: final_result.txt)")
     p.add_argument("--top-k", type=int, default=10,
@@ -129,6 +134,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "demote to disk mid-job. auto routes on corpus "
                         "size vs --collect-max-rows (estimated rows past "
                         "the cap pick disk, else hybrid)")
+    p.add_argument("--join-input", default="",
+                   help="join: the RIGHT/probe record corpus (.npy of "
+                        "(u64 key, u64 payload) rows, payloads < 2^63; "
+                        "the positional input is the left/build side)")
+    p.add_argument("--session-gap", type=int, default=3600,
+                   help="sessionize: consecutive same-key events more "
+                        "than this far apart (timestamp units) start a "
+                        "new session")
+    p.add_argument("--sort-sample", type=int, default=4096,
+                   help="sort: target key-sample size for the range "
+                        "splitters (deterministic strided sample; "
+                        "larger balances skew better)")
     p.add_argument("--rescan-full", action="store_true",
                    help="hash-only mode: rescan the whole corpus when "
                         "resolving winner strings (extends the collision "
@@ -292,6 +309,9 @@ def config_from_args(args: argparse.Namespace) -> JobConfig:
         host_sample_hz=args.host_sample_hz,
         calib_dir=args.calib_dir,
         rescan_full=args.rescan_full,
+        join_input_path=args.join_input,
+        session_gap=args.session_gap,
+        sort_sample=args.sort_sample,
         collect_max_rows=args.collect_max_rows,
         shuffle_transport=args.shuffle_transport,
         hll_precision=args.hll_precision,
@@ -303,6 +323,20 @@ def config_from_args(args: argparse.Namespace) -> JobConfig:
 
 
 def main(argv: list[str] | None = None) -> int:
+    try:
+        return _main(argv)
+    except BrokenPipeError:
+        # the downstream consumer closed the pipe early (`... | head` —
+        # exactly how the obs report commands are meant to be used, and
+        # how check.sh drives them): the reader got everything it
+        # wanted, so this is success, not an error.  Point stdout at
+        # devnull so the interpreter-exit flush cannot raise again.
+        devnull = os.open(os.devnull, os.O_WRONLY)
+        os.dup2(devnull, sys.stdout.fileno())
+        return 0
+
+
+def _main(argv: list[str] | None = None) -> int:
     if argv is None:
         argv = sys.argv[1:]
     if argv and argv[0] == "obs":
@@ -333,6 +367,11 @@ def main(argv: list[str] | None = None) -> int:
     if not os.path.isfile(config.input_path):
         print(f"error: cannot open input {config.input_path!r}", file=sys.stderr)
         return 2
+    if args.workload == "join" and not os.path.isfile(
+            config.join_input_path):
+        print(f"error: join needs --join-input; cannot open "
+              f"{config.join_input_path!r}", file=sys.stderr)
+        return 2
     if config.keep_intermediates and not config.checkpoint_dir:
         _log.warning("--keep-intermediates has no effect without "
                      "--checkpoint-dir (there are no intermediates: map "
@@ -346,6 +385,24 @@ def main(argv: list[str] | None = None) -> int:
         init_distributed(config.dist_coordinator,
                          config.dist_num_processes, config.dist_process_id)
         r = run_distributed_job(config, args.workload)
+        if args.workload in ("sort", "join", "sessionize"):
+            print(r.top_report(config.top_k)
+                  + f" ({config.dist_num_processes} processes)")
+            if config.output_path:
+                from map_oxidize_tpu.parallel.distributed import (
+                    partition_output_path,
+                )
+
+                _log.info(
+                    "process %d wrote its partition to %s (the %d parts "
+                    "concatenate%s)", config.dist_process_id,
+                    partition_output_path(config.output_path,
+                                          config.dist_process_id,
+                                          config.dist_num_processes),
+                    config.dist_num_processes,
+                    ", process-major, into the globally sorted artifact"
+                    if args.workload == "sort" else " disjointly")
+            return 0
         if args.workload == "kmeans":
             c = r.centroids
             print(f"k-means: {c.shape[0]} centroids, dim {c.shape[1]}, "

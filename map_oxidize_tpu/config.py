@@ -12,12 +12,21 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from pathlib import Path
 
-#: workloads the resident job service serves — the single source of
-#: truth for the scheduler's submit-time allowlist AND the submit CLI's
-#: ``choices`` (both import it from here, the one module each already
-#: depends on without pulling in jax)
-SERVE_WORKLOADS = ("wordcount", "bigram", "invertedindex", "kmeans",
-                   "distinct")
+#: every built-in workload ``run_job`` dispatches — THE single source of
+#: truth every allowlist derives from: the one-shot CLI's ``choices``,
+#: the resident scheduler's submit-time allowlist, and the submit CLI's
+#: ``choices`` all import it from here (the one module each already
+#: depends on without pulling in jax), so the lists cannot drift
+#: (tests/test_dataflow.py asserts they agree)
+WORKLOADS = ("wordcount", "bigram", "invertedindex", "kmeans",
+             "distinct", "sort", "join", "sessionize")
+
+#: workloads the resident job service serves — every built-in runs
+#: through the same drivers the scheduler multiplexes, so the serve
+#: allowlist IS the workload list (kept as its own name because the
+#: scheduler/submit surfaces bind to serve semantics, and a future
+#: serve-incompatible workload would subset here, in one place)
+SERVE_WORKLOADS = WORKLOADS
 
 
 @dataclass
@@ -221,6 +230,20 @@ class JobConfig:
     #: call (``shuffle_transport``): hybrid demotes to disk buckets, disk
     #: never stages residently in the first place, hbm aborts loudly.
     collect_max_rows: int = 0
+    #: join (hash equi-join): the RIGHT/probe record corpus
+    #: (``input_path`` is the left/build side).  Record model: a ``.npy``
+    #: of (u64 key, u64 payload) rows, payloads < 2^63 (the top bit tags
+    #: the side inside the shared engine) — see workloads/join.py
+    join_input_path: str = ""
+    #: sessionize: the gap (in the timestamp column's own units) above
+    #: which consecutive same-key events split into separate sessions
+    session_gap: int = 3600
+    #: sort: target key-sample size for the range splitters (an
+    #: every-kth-row strided sample of the whole input — deterministic,
+    #: so distributed processes derive identical splitters with no
+    #: collective).  Larger samples balance skewed inputs better at the
+    #: cost of one longer strided read
+    sort_sample: int = 4096
     #: shuffle transport for the collect engines (map_oxidize_tpu.shuffle):
     #: where shuffled rows stage and what happens at the resident-row cap.
     #: 'hbm' = strictly resident (device buffers / host RAM; the cap is a
@@ -275,6 +298,10 @@ class JobConfig:
                              f"got {self.kmeans_precision!r}")
         if self.collect_max_rows < 0:
             raise ValueError("collect_max_rows must be >= 0 (0 = default)")
+        if self.session_gap < 1:
+            raise ValueError("session_gap must be >= 1 (timestamp units)")
+        if self.sort_sample < 1:
+            raise ValueError("sort_sample must be >= 1 sampled keys")
         from map_oxidize_tpu.shuffle.base import TRANSPORTS
 
         if self.shuffle_transport not in TRANSPORTS:

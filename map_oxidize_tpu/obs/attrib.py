@@ -59,6 +59,12 @@ Bucket definitions (ms on the job's critical path):
     included).
 ``spill_io``
     Disk-bucket shuffle spill writes and drains (``spill/io_ms``).
+``host_sort``
+    Host-side dataflow finalize compute on the critical path — the
+    lexsorts, join probe expansion, session gap cuts, and ordered drain
+    writes of the sort/join/sessionize drivers (``attrib/host_sort_ms``;
+    the measuring windows subtract any spill I/O paid inside them, which
+    ``spill_io`` owns, so the buckets stay disjoint).
 ``compile``
     Wall of compiling dispatches (trace + XLA backend compile), from
     the job's compile-ledger window.
@@ -77,15 +83,15 @@ ATTRIB_SCHEMA = "moxt-attrib-v1"
 #: bucket order for reports (stable, most-upstream first)
 BUCKETS = ("setup", "host_produce", "feed_wait", "host_stage",
            "dispatch_gap", "device_compute", "collective_wait",
-           "spill_io", "compile", "host_write")
+           "spill_io", "host_sort", "compile", "host_write")
 
 #: short spellings for the heartbeat's one-token ``where=`` field
 SHORT = {
     "setup": "setup", "host_produce": "produce", "feed_wait": "wait",
     "host_stage": "stage", "dispatch_gap": "dispatch",
     "device_compute": "compute", "collective_wait": "comms",
-    "spill_io": "spill", "compile": "compile", "host_write": "write",
-    "unattributed": "other",
+    "spill_io": "spill", "host_sort": "sort", "compile": "compile",
+    "host_write": "write", "unattributed": "other",
 }
 
 #: ``obs diff --gate``: an unattributed fraction growing by more than
@@ -96,8 +102,9 @@ UNATTRIBUTED_GATE_POINTS = 10.0
 #: host-only phases attributed wholesale (no device dispatch ever runs
 #: inside them — ``replay`` and the finalize family do dispatch, so
 #: they are deliberately NOT here and contribute via the metric-derived
-#: buckets instead)
-_PRODUCE_PHASES = ("split",)
+#: buckets instead).  ``sample`` is the sort driver's splitter-sampling
+#: phase: a pure host strided read, host produce by definition.
+_PRODUCE_PHASES = ("split", "sample")
 _WRITE_PHASES = ("write",)
 
 
@@ -170,6 +177,7 @@ def compute(obs, programs: dict | None = None,
         "device_compute": compute_ms,
         "collective_wait": flag_wait_ms,
         "spill_io": spill_io,
+        "host_sort": float(counters.get("attrib/host_sort_ms", 0.0)),
         "compile": compile_ms,
         "host_write": sum(phases.get(p, 0.0)
                           for p in _WRITE_PHASES) * 1e3,

@@ -268,6 +268,16 @@ def capture(out_dir: str, duration_s: float = DEFAULT_CAPTURE_S,
 _FRAME_BUCKETS = (
     ("pipeline.py:_produce", "host_produce"),
     ("kmeans.py:_stage", "host_produce"),
+    # dataflow finalize compute (the attribution ledger's host_sort
+    # bucket): the intra-bucket/host lexsorts, the join probe, and the
+    # session gap scan — checked BEFORE the generic spill needles so a
+    # sort running inside a bucket drain classifies as the sort, while
+    # the drain's file I/O frames still classify spill_io
+    ("collect.py:_sorted_host_pairs", "host_sort"),
+    ("distributed.py:_sort_kd", "host_sort"),
+    ("join.py:probe_join_csr", "host_sort"),
+    ("sessionize.py:sessions_from_csr", "host_sort"),
+    ("sort.py:write_sorted_records", "host_sort"),
     ("spill.py:", "spill_io"),
     ("disk.py:", "spill_io"),
     (":block_until_ready", "device_compute"),

@@ -82,13 +82,34 @@ class ShardedCollectEngine:
     ``finalize``), so the inverted-index driver is engine-agnostic."""
 
     def __init__(self, config: JobConfig, mesh=None, bucket_cap: int = 0,
-                 max_rows: int = 1 << 27):
+                 max_rows: int = 1 << 27, splitters=None,
+                 pair_order: str = "stable"):
         from map_oxidize_tpu.shuffle import make_transport, resolve_transport
 
         self.config = config
         self.mesh = mesh if mesh is not None else make_mesh(
             config.num_shards, config.backend)
         self.S = S = self.mesh.shape[SHARD_AXIS]
+        #: RANGE partition instead of the hash partition: S-1 ascending
+        #: u64 splitter keys (the total-order sort's sampled quantiles).
+        #: Shard s then owns keys in [splitters[s-1], splitters[s]) —
+        #: per-shard sorted runs concatenate shard-major into the GLOBAL
+        #: key order, which is the property the sort workload buys here.
+        #: None keeps the hash partition (grouping workloads: shard
+        #: order is arbitrary, only segment disjointness matters).
+        self.splitters = None
+        if splitters is not None:
+            splitters = np.asarray(splitters, np.uint64)
+            if splitters.shape != (S - 1,):
+                raise ValueError(
+                    f"splitters must be (num_shards-1,) = ({S - 1},) "
+                    f"ascending u64 keys, got shape {splitters.shape}")
+            self.splitters = splitters
+        #: host drain sort discipline, threaded to every CollectEngine
+        #: this engine demotes to (see runtime/collect.py): "stable"
+        #: keeps the feed-order stability contract, "lex" the full
+        #: unsigned (key, doc) lexsort the dataflow workloads need
+        self.pair_order = pair_order
         self.batch_per_shard = max(1, config.batch_size // S)
         self.feed_batch = self.batch_per_shard * S
         # fully-safe default: one bucket can absorb a shard's whole block
@@ -127,7 +148,8 @@ class ShardedCollectEngine:
         def _route_append(bh, bl, bdh, bdl, cur, hi, lo, dhi, dlo):
             vals = jnp.stack([dhi, dlo], axis=1)
             r_hi, r_lo, r_vals, ovf = _exchange(
-                hi, lo, vals, S, self.bucket_cap)
+                hi, lo, vals, S, self.bucket_cap,
+                dest=self._dest_of(hi, lo))
             # compact: 2-key sort moves SENTINEL rows (key = max) to the
             # end; doc planes ride along
             s_h, s_l, s_dh, s_dl = lax.sort(
@@ -146,12 +168,16 @@ class ShardedCollectEngine:
 
         from map_oxidize_tpu.obs.compile import observed_jit
 
+        # the range-routed variant is a genuinely different XLA program
+        # under the same ledger name; the tag keeps the two cache slots
+        # (and recompile causes) apart, same scheme as collect/grow
         self._route_append = observed_jit("collect/route_append", jax.jit(
             shard_map(
                 _route_append, mesh=self.mesh,
                 in_specs=(row2,) * 4 + (spec,) * 5,
                 out_specs=(row2,) * 4 + (spec, P()),
-            ), donate_argnums=(0, 1, 2, 3, 4)))
+            ), donate_argnums=(0, 1, 2, 3, 4)),
+            tag="range" if self.splitters is not None else None)
 
         def _grow(bh, bl, bdh, bdl, pad):
             filler = jnp.full((1, pad), jnp.uint32(SENTINEL))
@@ -192,6 +218,19 @@ class ShardedCollectEngine:
         if self._host is not None:
             self._host.obs = value
 
+    def _dest_of(self, hi, lo):
+        """In-trace destination rows for one exchange: ``None`` keeps
+        :func:`parallel.shuffle._exchange`'s hash buckets; with
+        ``splitters`` pinned, the range partition (splitter planes are
+        compile-time constants — S-1 values, replicated)."""
+        if self.splitters is None:
+            return None
+        from map_oxidize_tpu.ops.hashing import split_u64
+        from map_oxidize_tpu.parallel.shuffle import range_dest
+
+        sp_hi, sp_lo = split_u64(self.splitters)
+        return range_dest(hi, lo, sp_hi, sp_lo)
+
     def _activate_disk_transport(self) -> None:
         """Disk transport on the single-controller sharded engine: rows
         never stage in HBM at all — the host pair engine (whose own
@@ -205,7 +244,8 @@ class ShardedCollectEngine:
         # 'device' applies to the single-chip engine only, and the disk
         # stage is host-sorted by definition
         host = CollectEngine(self.config, max_rows=self.max_rows,
-                             sort_mode="host", transport="disk")
+                             sort_mode="host", transport="disk",
+                             pair_order=self.pair_order)
         host.obs = self.obs
         self._host = host
 
@@ -304,7 +344,8 @@ class ShardedCollectEngine:
             "device buffers to the host engine (disk-bucket spill)",
             self.max_rows, self.S)
         host = CollectEngine(self.config, max_rows=self.max_rows,
-                             sort_mode="host")  # target regardless of
+                             sort_mode="host",  # target regardless of
+                             pair_order=self.pair_order)
         host.obs = self.obs  # the spill level keeps recording downstream
         # the host engine is the demotion TARGET: its own spill begin is
         # part of this one transition, not a second demotion event
@@ -338,6 +379,14 @@ class ShardedCollectEngine:
         if self._host is None:
             raise RuntimeError("engine did not demote/spill; use finalize")
         return self._host.finalize_spilled_csr()
+
+    def finalize_spilled_runs(self):
+        """Delegates to the demoted host engine (see
+        :meth:`CollectEngine.finalize_spilled_runs`): sorted (keys,
+        docs) runs whose concatenation is globally key-ascending."""
+        if self._host is None:
+            raise RuntimeError("engine did not demote/spill; use finalize")
+        return self._host.finalize_spilled_runs()
 
     def flush(self) -> None:
         if not self._staged:

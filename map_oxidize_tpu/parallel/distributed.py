@@ -378,7 +378,8 @@ class DistributedCollectEngine(ShardedCollectEngineBase):
 
         def _route(hi, lo, dhi, dlo):
             vals = jnp.stack([dhi, dlo], axis=1)
-            r_hi, r_lo, r_vals, ovf = _exchange(hi, lo, vals, S, cap)
+            r_hi, r_lo, r_vals, ovf = _exchange(
+                hi, lo, vals, S, cap, dest=self._dest_of(hi, lo))
             return (r_hi[None], r_lo[None], r_vals[:, 0][None],
                     r_vals[:, 1][None], ovf)
 
@@ -386,7 +387,8 @@ class DistributedCollectEngine(ShardedCollectEngineBase):
         row2 = P(SHARD_AXIS, None)
         return observed_jit("shuffle/route_spill", jax.jit(shard_map(
             _route, mesh=self.mesh, in_specs=(spec,) * 4,
-            out_specs=(row2,) * 4 + (P(),))))
+            out_specs=(row2,) * 4 + (P(),))),
+            tag="range" if self.splitters is not None else None)
 
     def _route_to_spill(self, batch, n: int) -> None:
         import time as _time
@@ -477,17 +479,40 @@ class DistributedCollectEngine(ShardedCollectEngineBase):
         if self._disk is None:
             raise RuntimeError("engine did not spill; use finalize")
         self._check_exchange_overflows()
-
-        def _sort_kd(keys, docs):
-            order = np.lexsort((docs, keys))
-            return keys[order], docs[order]
-
         self._spilled_rows_total = self._disk.rows
-        terms, offsets, docs, holder, peak = self._disk.drain_csr(_sort_kd)
+        terms, offsets, docs, holder, peak = self._disk.drain_csr(
+            self._sort_kd)
         self._disk = None
         if self.obs is not None and peak:
             self.obs.registry.gauge_max("shuffle/peak_staged_rows", peak)
         return terms, offsets, docs, holder
+
+    def _sort_kd(self, keys, docs):
+        """The spilled drain's intra-bucket sort: always the full
+        (key, doc) lexsort (cross-process interleave, see
+        :meth:`finalize_spilled_csr`); under ``pair_order='lex'`` the
+        doc plane compares UNSIGNED (dataflow payloads are arbitrary
+        u64 bit patterns — an i64 view would order the top-bit half
+        first; doc ids are never negative, so the ii path is
+        unchanged either way)."""
+        d = docs.view(np.uint64) if self.pair_order == "lex" else docs
+        order = np.lexsort((d, keys))
+        return keys[order], docs[order]
+
+    def finalize_spilled_runs(self):
+        """Sorted-run drain of THIS process's disk partition (the
+        distributed sort's spilled finalize): yields lexsorted
+        ``(keys, docs)`` blocks in ascending top-bit bucket order.
+        Under a range partition the process's shards own a contiguous
+        key range, so its drained blocks concatenate sorted — and the
+        per-process part files concatenate, process-major, into the
+        globally sorted artifact."""
+        if self._disk is None:
+            raise RuntimeError("engine did not spill; use finalize")
+        self._check_exchange_overflows()
+        self._spilled_rows_total = self._disk.rows
+        disk, self._disk = self._disk, None
+        return disk.drain_sorted(self._sort_kd)
 
     def feed(self, out):  # pragma: no cover - contract guard
         raise NotImplementedError(
@@ -883,6 +908,12 @@ def run_distributed_job(config: JobConfig, workload: str
             return _run_distributed_distinct(config, obs)
         if workload == "kmeans":
             return _run_distributed_kmeans(config, obs)
+        if workload in ("sort", "join", "sessionize"):
+            from map_oxidize_tpu.parallel.dataflow import (
+                run_distributed_dataflow,
+            )
+
+            return run_distributed_dataflow(config, workload, obs)
         return _run_distributed_core(config, workload, obs)
 
 
@@ -1157,15 +1188,28 @@ def finish_distributed_obs(obs: Obs, config: JobConfig, workload: str
         sample_host_memory,
     )
 
+    import time as _time
+
+    from map_oxidize_tpu.obs import attrib as _attrib
+
     obs.stop_live()
     xprof_report = obs.finish_xprof()
+    # the end-of-job wall attribution, same as Obs.finish: each
+    # process's own decomposition (collective_wait carries its lockstep
+    # share) — attrib/* gauges for the ledger/gate plus the structured
+    # section this process's metrics document carries, so `obs where`
+    # answers for distributed runs too
+    attrib_doc = _attrib.finalize(
+        obs, xprof_report,
+        max(_time.time() - obs.tracer.wall_start, 1e-9))
     sample_host_memory(obs.registry)
     sample_device_memory(obs.registry)
     if obs.heartbeat is not None:
         obs.heartbeat.final_beat()
     P_ = obs.n_processes
     meta = obs.stamp(config, workload)
-    metrics_doc = dict(obs.registry.to_dict(), meta=meta)
+    metrics_doc = dict(obs.registry.to_dict(), meta=meta,
+                       attrib=attrib_doc)
     if xprof_report is not None:
         # per-process xprof shards merge like everything else: each
         # process's metrics doc carries its own program table

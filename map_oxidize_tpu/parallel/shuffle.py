@@ -59,19 +59,39 @@ def bucket_of(hi: jnp.ndarray, lo: jnp.ndarray, num_shards: int) -> jnp.ndarray:
     return ((hi ^ lo) % jnp.uint32(num_shards)).astype(jnp.int32)
 
 
-def _exchange(hi, lo, vals, num_shards: int, cap: int):
+def range_dest(hi, lo, sp_hi, sp_lo) -> jnp.ndarray:
+    """Owner shard of a 64-bit key under a RANGE partition (the total-order
+    sort's routing): the count of splitters ``<=`` the key — exactly
+    ``searchsorted(splitters, key, side='right')``, so a key equal to
+    splitter ``j`` lands deterministically on shard ``j+1`` and shard 0
+    owns everything below the first splitter.  Keys travel as (hi, lo)
+    u32 planes (x64 is disabled in-trace), so the comparison is the
+    lexicographic plane compare; splitters are S-1 values broadcast
+    against the batch.  MUST match the host partitioner
+    (:func:`map_oxidize_tpu.workloads.sort.range_partition`) bit for bit —
+    the property suite pins the pair."""
+    ge = (hi[:, None] > sp_hi[None, :]) | (
+        (hi[:, None] == sp_hi[None, :]) & (lo[:, None] >= sp_lo[None, :]))
+    return jnp.sum(ge.astype(jnp.int32), axis=1)
+
+
+def _exchange(hi, lo, vals, num_shards: int, cap: int, dest=None):
     """Per-shard body: route rows to their owner shard via all_to_all.
 
-    Returns ``(hi, lo, vals)`` of shape ``[S*cap, ...]`` — the rows this shard
-    owns after the exchange — plus the global count of overflow-dropped rows
-    (replicated scalar; caller raises on nonzero).
+    ``dest`` overrides the hash-bucket destination per row (the sort
+    engine's range partition); padding rows are re-routed round-robin
+    either way.  Returns ``(hi, lo, vals)`` of shape ``[S*cap, ...]`` —
+    the rows this shard owns after the exchange — plus the global count of
+    overflow-dropped rows (replicated scalar; caller raises on nonzero).
     """
     B = hi.shape[0]
     S = num_shards
     is_pad = (hi == jnp.uint32(SENTINEL)) & (lo == jnp.uint32(SENTINEL))
     # padding rows are spread round-robin so they never overflow one bucket
     rr = (jnp.arange(B, dtype=jnp.int32) % S)
-    dest = jnp.where(is_pad, rr, bucket_of(hi, lo, S))
+    if dest is None:
+        dest = bucket_of(hi, lo, S)
+    dest = jnp.where(is_pad, rr, dest)
 
     # stable sort by destination; values ride as a permutation index
     idx = jnp.arange(B, dtype=jnp.int32)

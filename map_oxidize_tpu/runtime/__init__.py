@@ -75,6 +75,16 @@ def _run_job(config: JobConfig, workload: str, on_obs=None):
         from map_oxidize_tpu.runtime.driver import run_distinct_job
 
         return run_distinct_job(config, on_obs=on_obs)
+    if workload in ("sort", "join", "sessionize"):
+        from map_oxidize_tpu.runtime.dataflow import (
+            run_join_job,
+            run_sessionize_job,
+            run_sort_job,
+        )
+
+        runner = {"sort": run_sort_job, "join": run_join_job,
+                  "sessionize": run_sessionize_job}[workload]
+        return runner(config, on_obs=on_obs)
     mode = resolve_mapper(config, workload)
     if mode == "device":
         from map_oxidize_tpu.runtime.device_map import (

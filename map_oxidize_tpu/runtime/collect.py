@@ -83,10 +83,20 @@ class CollectEngine:
 
     def __init__(self, config: JobConfig, device=None,
                  max_rows: int = 1 << 27, sort_mode: str | None = None,
-                 transport: str | None = None):
+                 transport: str | None = None, pair_order: str = "stable"):
         from map_oxidize_tpu.shuffle import make_transport, resolve_transport
 
         self.config = config
+        #: host finalize sort discipline: ``"stable"`` = stable-by-key
+        #: (feed order already implies ascending docs per key — the
+        #: inverted-index contract), ``"lex"`` = full (key, doc) lexsort
+        #: with the doc plane compared UNSIGNED (the dataflow workloads'
+        #: contract: payloads are arbitrary u64 bit patterns, and an i64
+        #: view would order the top-bit half first)
+        if pair_order not in ("stable", "lex"):
+            raise ValueError(f"pair_order must be stable|lex, "
+                             f"got {pair_order!r}")
+        self.pair_order = pair_order
         # callers that already made the placement decision (the sharded
         # engine's demotion target / disk stage is always host-sorted)
         # pin sort_mode/transport at construction instead of mutating
@@ -232,6 +242,20 @@ class CollectEngine:
         self._spill = None
         return terms, offsets, docs, holder
 
+    def finalize_spilled_runs(self):
+        """Sorted-RUN finalize for spilled runs (the total-order sort's
+        drain): yields ``(keys, docs)`` blocks, one per non-empty disk
+        bucket, each internally sorted by this engine's ``pair_order``.
+        Buckets are top-bit key ranges, so the concatenated blocks are
+        globally key-ascending — under ``pair_order='lex'`` the
+        concatenation IS the total (key, doc) order.  Resident memory:
+        one bucket at a time.  Consumes the stage."""
+        if self._spill is None:
+            raise RuntimeError("finalize_spilled_runs on an unspilled "
+                               "engine; use finalize")
+        spill, self._spill = self._spill, None
+        return spill.drain_sorted(self._sorted_host_pairs)
+
     def flush(self) -> None:
         if self.sort_mode == "host" or not self._staged:
             return
@@ -283,7 +307,15 @@ class CollectEngine:
         stable argsort at 30M rows; numpy remains the fallback.
         The parity suites (vs the independent oracle) pin the
         ascending-doc invariant; a mapper that emitted docs out of
-        order would fail them."""
+        order would fail them.
+
+        ``pair_order='lex'`` replaces the stability argument with a full
+        (key, doc-as-u64) lexsort — the dataflow workloads feed docs in
+        arbitrary order (payloads, timestamps, side-tagged rows), so
+        only the explicit two-column sort yields the oracle order."""
+        if self.pair_order == "lex":
+            order = np.lexsort((docs.view(np.uint64), keys))
+            return keys[order], docs[order]
         from map_oxidize_tpu.native.build import sort_kd_or_none
 
         if self.config.use_native:

@@ -94,6 +94,12 @@ def estimate_hbm_bytes(config, workload: str) -> int:
         return (1 << config.hll_precision) * 8 + batch
     if workload == "invertedindex":
         return int(config.batch_size) * 24
+    if workload in ("sort", "join", "sessionize"):
+        # pair-collect staging: one padded (4, B) exchange block plus
+        # the per-shard receive buffers' next-block headroom (~24B/row,
+        # the invertedindex model — the dataflow workloads ride the
+        # same engine family; spilled rows live on disk, not HBM)
+        return int(config.batch_size) * 24
     # wordcount / bigram: fold accumulator + feed staging (the collect
     # route stages even less on device, so this stays an upper bound)
     return int(config.key_capacity) * 16 + batch

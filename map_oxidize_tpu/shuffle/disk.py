@@ -172,6 +172,28 @@ class DiskPairStage:
         docs = np.memmap(doc_path, np.int64, mode="r")
         return terms, offsets, docs, holder, peak
 
+    def drain_sorted(self, sort_pairs):
+        """Bucket-by-bucket sorted-RUN drain (the total-order sort's
+        finalize, CSR-free): yields ``(keys, docs)`` per non-empty
+        bucket, each block sorted by ``sort_pairs`` — buckets are
+        top-bit key RANGES, so the yielded blocks concatenate into the
+        globally key-ascending stream, and a full (key, doc) lexsort
+        per bucket makes that concatenation the exact total order.
+        Resident memory: one bucket at a time.  Consumes the stage
+        (bucket files unlink as they drain; the temp dir is removed
+        when the generator finishes)."""
+        try:
+            for i in range(self.n_buckets):
+                rec = self.take(i)
+                if rec is None:
+                    continue
+                keys = np.ascontiguousarray(rec["k"])
+                docs = np.ascontiguousarray(rec["d"])
+                del rec
+                yield sort_pairs(keys, docs)
+        finally:
+            self.cleanup()
+
     def release(self):
         """Hand the temp directory to the caller (keeps on-disk finalize
         artifacts like the CSR doc column alive)."""

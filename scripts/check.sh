@@ -155,6 +155,83 @@ print(f"spilled shuffle OK: 2-proc completed past the cap "
       "parity exact, default path zero-spill")
 EOF
 
+echo "== sort smoke =="
+# ISSUE-14 acceptance: a 2-process Gloo total-order sort forced far past
+# --collect-max-rows must COMPLETE via per-process disk buckets with
+# globally-sorted, oracle-exact concatenated output and nonzero
+# spill/rows on every process — and obs where must attribute >= 90% of
+# the job's wall (the shuffle route + per-shard sort + host drains land
+# in named buckets, not unattributed_pct)
+python - "$smoke" <<'EOF'
+import sys
+import numpy as np
+rng = np.random.default_rng(17)
+n = 300_000
+keys = rng.integers(0, 1 << 64, n, dtype=np.uint64)
+keys[keys == np.uint64((1 << 64) - 1)] -= np.uint64(1)
+pay = rng.integers(0, 1 << 64, n, dtype=np.uint64)
+np.save(f"{sys.argv[1]}/sort_recs.npy", np.stack([keys, pay], axis=1))
+EOF
+sort_port=$(python - <<'EOF'
+import socket
+s = socket.socket(); s.bind(("127.0.0.1", 0))
+print(s.getsockname()[1]); s.close()
+EOF
+)
+sort_pids=()
+for p in 0 1; do
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=4 \
+        timeout -k 10 600 \
+        python -m map_oxidize_tpu sort "$smoke/sort_recs.npy" \
+        --output "$smoke/sorted.bin" --batch-size 65536 --chunk-mb 1 \
+        --collect-max-rows 32768 --quiet \
+        --dist-coordinator "127.0.0.1:$sort_port" --dist-processes 2 \
+        --dist-process-id "$p" \
+        --metrics-out "$smoke/sort_metrics.json" > /dev/null &
+    sort_pids+=($!)
+done
+sort_rc=0
+for pid in "${sort_pids[@]}"; do wait "$pid" || sort_rc=$?; done
+if [ "$sort_rc" -ne 0 ]; then
+    echo "sort smoke: a 2-proc child failed (rc=$sort_rc)"
+    exit "$sort_rc"
+fi
+python - "$smoke" <<'EOF'
+import json, sys
+import numpy as np
+d = sys.argv[1]
+from map_oxidize_tpu.workloads.sort import read_sorted_records, sort_model
+recs = np.load(f"{d}/sort_recs.npy").view(np.uint64)
+want_k, want_p = sort_model(recs[:, 0], recs[:, 1])
+parts = [read_sorted_records(f"{d}/sorted.bin.part{i}of2")
+         for i in range(2)]
+got_k = np.concatenate([p[0] for p in parts])
+got_p = np.concatenate([p[1] for p in parts])
+# the parts concatenate PROCESS-MAJOR into the exact total order — no
+# post-hoc sort here, the artifact itself must already be ordered
+assert np.array_equal(got_k, want_k), "sort output not oracle-ordered"
+assert np.array_equal(got_p, want_p), "sort payload order mismatch"
+spilled = 0
+for i in range(2):
+    m = json.load(open(f"{d}/sort_metrics.json.proc{i}"))
+    assert m["gauges"]["shuffle/transport"] == "disk", \
+        f"auto should route this corpus/cap ratio to disk: {m['gauges']}"
+    r = m["counters"].get("spill/rows", 0)
+    assert r > 0, f"process {i} never spilled"
+    spilled += r
+    att = m.get("attrib") or {}
+    pct = att.get("unattributed_pct")
+    assert pct is not None and pct <= 10.0, \
+        f"process {i}: obs where attributes only " \
+        f"{100 - (pct or 100):.1f}% of the sort wall ({att})"
+assert spilled == recs.shape[0], (spilled, recs.shape[0])
+print(f"sort smoke OK: 2-proc spilled sort globally ordered "
+      f"({spilled} rows through per-process disk buckets, "
+      f">=90% of wall attributed)")
+EOF
+# obs where renders the sort decomposition from the metrics doc
+python -m map_oxidize_tpu obs where "$smoke/sort_metrics.json.proc0"
+
 echo "== dispatch-floor smoke =="
 # scan-batched streamed k-means: a center-seeded corpus streams through
 # the device in 5 chunks/iteration at --dispatch-batch 4 (one full block

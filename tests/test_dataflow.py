@@ -1,0 +1,388 @@
+"""Dataflow workloads (ISSUE-14 tentpole): total-order sort, hash
+equi-join, and sessionize — oracle-exact on the single chip AND the
+8-virtual-device mesh, through the shuffle transports (forced-spill
+sort included), with the range partitioner property-tested on
+adversarial inputs and every workload allowlist pinned to the single
+source of truth in ``config.py``.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from map_oxidize_tpu.config import JobConfig
+from map_oxidize_tpu.runtime import run_job
+from map_oxidize_tpu.workloads.join import (
+    join_model,
+    read_join_records,
+)
+from map_oxidize_tpu.workloads.sessionize import sessionize_model
+from map_oxidize_tpu.workloads.sort import (
+    RESERVED_KEY,
+    compute_splitters,
+    range_partition,
+    read_sorted_records,
+    sort_model,
+)
+
+
+def _cfg(tmp_path, inp, out, shards, **kw):
+    kw.setdefault("chunk_bytes", 16 * 512)
+    kw.setdefault("batch_size", 1 << 12)
+    return JobConfig(input_path=str(inp),
+                     output_path=str(tmp_path / out) if out else "",
+                     backend="cpu", num_shards=shards, metrics=False,
+                     **kw)
+
+
+def _records(tmp_path, name, keys, payloads=None):
+    path = tmp_path / name
+    if payloads is None:
+        np.save(path, keys)
+    else:
+        np.save(path, np.stack([keys, payloads], axis=1))
+    return str(path) + ".npy" if not str(path).endswith(".npy") else str(path)
+
+
+# --- the range partitioner: adversarial property suite ----------------------
+
+
+#: adversarial key distributions: uniform, zipf-skewed, duplicate
+#: floods, constants, near-sentinel, tiny, empty
+def _adversarial_samples():
+    rng = np.random.default_rng(42)
+    yield "uniform", rng.integers(0, 1 << 64, 5000, dtype=np.uint64)
+    z = np.minimum(rng.zipf(1.3, 5000), 1 << 20).astype(np.uint64)
+    yield "zipf_skew", z
+    d = rng.integers(0, 8, 5000, dtype=np.uint64)  # 8 distinct values
+    yield "duplicate_flood", d
+    yield "constant", np.full(1000, 7, np.uint64)
+    yield "near_max", np.full(64, (1 << 64) - 2, np.uint64)
+    yield "single", np.array([123], np.uint64)
+    yield "empty", np.empty(0, np.uint64)
+
+
+@pytest.mark.parametrize("num_shards", [2, 3, 8])
+def test_splitters_cover_disjoint_monotone(num_shards):
+    """On EVERY adversarial sample: splitters are (S-1,) nondecreasing;
+    the induced partition covers every probe key exactly once (dest in
+    [0, S)); the shard index is monotone in the key (so per-shard runs
+    concatenate in key order); and ties at a splitter break
+    deterministically to the right shard."""
+    rng = np.random.default_rng(7)
+    probes = np.concatenate([
+        rng.integers(0, 1 << 64, 4000, dtype=np.uint64),
+        np.array([0, 1, (1 << 64) - 1, (1 << 64) - 2], np.uint64),
+    ])
+    for name, sample in _adversarial_samples():
+        sp = compute_splitters(sample, num_shards)
+        assert sp.shape == (num_shards - 1,), name
+        assert sp.dtype == np.uint64, name
+        # nondecreasing (duplicates allowed: empty shards are valid)
+        assert np.all(sp[1:] >= sp[:-1]), name
+        dest = range_partition(probes, sp)
+        # covering + disjoint: every key maps to exactly one shard in range
+        assert dest.shape == probes.shape, name
+        assert int(dest.min()) >= 0 and int(dest.max()) < num_shards, name
+        # order-preserving: sorted keys -> nondecreasing shard ids
+        order = np.argsort(probes, kind="stable")
+        sdest = dest[order]
+        assert np.all(sdest[1:] >= sdest[:-1]), name
+        # deterministic ties: a key EQUAL to splitter j goes right of it
+        for j, s in enumerate(sp.tolist()):
+            assert int(range_partition(
+                np.array([s], np.uint64), sp)[0]) >= j + 1, (name, j)
+        # sample keys themselves must be covered too
+        if sample.size:
+            sd = range_partition(sample, sp)
+            assert int(sd.min()) >= 0 and int(sd.max()) < num_shards, name
+
+
+def test_splitters_empty_sample_still_covers():
+    """An empty sample yields evenly spaced u64-space splitters — the
+    partition still covers (no crash, no degenerate all-to-one-shard)."""
+    sp = compute_splitters(np.empty(0, np.uint64), 4)
+    assert sp.shape == (3,)
+    dest = range_partition(
+        np.array([0, 1 << 62, 2 << 62, 3 << 62, (1 << 64) - 1],
+                 np.uint64), sp)
+    assert dest.tolist() == [0, 1, 2, 3, 3]
+
+
+def test_device_range_dest_matches_host_partitioner():
+    """The in-trace router (:func:`parallel.shuffle.range_dest`) and the
+    host partitioner must agree bit for bit — including at splitter
+    ties — or the distributed partition writes would disagree with the
+    routing."""
+    import jax
+
+    from map_oxidize_tpu.ops.hashing import split_u64
+    from map_oxidize_tpu.parallel.shuffle import range_dest
+
+    rng = np.random.default_rng(11)
+    for _name, sample in _adversarial_samples():
+        for S in (2, 8):
+            sp = compute_splitters(sample, S)
+            keys = np.concatenate([
+                rng.integers(0, 1 << 64, 1000, dtype=np.uint64),
+                sp,  # the tie cases
+                np.array([0, (1 << 64) - 1], np.uint64),
+            ])
+            hi, lo = split_u64(keys)
+            sp_hi, sp_lo = split_u64(sp)
+            got = np.asarray(jax.jit(range_dest)(hi, lo, sp_hi, sp_lo))
+            want = range_partition(keys, sp)
+            assert np.array_equal(got, want), (_name, S)
+
+
+# --- total-order sort -------------------------------------------------------
+
+
+@pytest.mark.parametrize("shards", [1, 8])
+def test_sort_oracle_exact(tmp_path, shards):
+    rng = np.random.default_rng(1)
+    n = 5000
+    keys = rng.integers(0, 1 << 62, n, dtype=np.uint64)
+    keys[:500] = keys[0]  # duplicate-heavy head: payload order matters
+    pay = rng.integers(0, 1 << 64, n, dtype=np.uint64)
+    inp = _records(tmp_path, "recs.npy", keys, pay)
+    r = run_job(_cfg(tmp_path, inp, f"s{shards}.bin", shards), "sort")
+    gk, gp = read_sorted_records(tmp_path / f"s{shards}.bin")
+    wk, wp = sort_model(keys, pay)
+    assert np.array_equal(gk, wk)
+    assert np.array_equal(gp, wp)
+    assert r.n_rows == n and r.spilled_rows == 0
+
+
+def test_sort_keys_only_payload_is_row_index(tmp_path):
+    """A (n,) keys-only input sorts with the global row index as the
+    payload — i.e. a STABLE sort, verifiable per duplicate."""
+    rng = np.random.default_rng(2)
+    keys = rng.integers(0, 50, 3000, dtype=np.uint64)  # heavy duplicates
+    inp = _records(tmp_path, "keys.npy", keys)
+    run_job(_cfg(tmp_path, inp, "sk.bin", 8), "sort")
+    gk, gp = read_sorted_records(tmp_path / "sk.bin")
+    wk, wp = sort_model(keys, np.arange(keys.shape[0], dtype=np.uint64))
+    assert np.array_equal(gk, wk)
+    assert np.array_equal(gp, wp)
+
+
+@pytest.mark.parametrize("shards,transport", [(1, "disk"), (8, "hybrid")])
+def test_sort_forced_spill_total_order(tmp_path, shards, transport):
+    """The acceptance scenario: a sort forced past --collect-max-rows
+    COMPLETES via disk buckets with oracle-exact, globally sorted
+    output and nonzero spill/rows — on the single chip (disk from row
+    0) and through the mesh engine's mid-job demotion (hybrid)."""
+    rng = np.random.default_rng(3)
+    n = 6000
+    keys = rng.integers(0, 1 << 64, n, dtype=np.uint64)
+    keys[keys == RESERVED_KEY] -= np.uint64(1)
+    pay = rng.integers(0, 1 << 64, n, dtype=np.uint64)
+    inp = _records(tmp_path, "recs.npy", keys, pay)
+    r = run_job(_cfg(tmp_path, inp, f"sp{shards}.bin", shards,
+                     collect_max_rows=1000, shuffle_transport=transport),
+                "sort")
+    gk, gp = read_sorted_records(tmp_path / f"sp{shards}.bin")
+    wk, wp = sort_model(keys, pay)
+    assert np.array_equal(gk, wk)
+    assert np.array_equal(gp, wp)
+    assert r.spilled_rows == n
+    assert r.metrics.get("spill/rows", 0) > 0
+
+
+def test_sort_reserved_key_refused(tmp_path):
+    keys = np.array([1, RESERVED_KEY, 2], np.uint64)
+    inp = _records(tmp_path, "bad.npy", keys)
+    with pytest.raises(Exception, match="reserved key"):
+        run_job(_cfg(tmp_path, inp, "x.bin", 1), "sort")
+
+
+def test_sort_attribution_covers_the_wall(tmp_path):
+    """The satellite bar: ``obs where`` attributes >= 90% of a sort
+    job's wall — the shuffle route + per-shard sort + host drains must
+    land in named buckets, not ``unattributed_pct`` — and the bucket
+    sum never exceeds the wall (disjointness)."""
+    rng = np.random.default_rng(4)
+    n = 200_000
+    keys = rng.integers(0, 1 << 62, n, dtype=np.uint64)
+    pay = rng.integers(0, 1 << 63, n, dtype=np.uint64)
+    inp = _records(tmp_path, "recs.npy", keys, pay)
+    cfg = _cfg(tmp_path, inp, "att.bin", 8,
+               chunk_bytes=16 * 65536, batch_size=1 << 16,
+               metrics_out=str(tmp_path / "m.json"))
+    run_job(cfg, "sort")
+    doc = json.load(open(tmp_path / "m.json"))
+    att = doc["attrib"]
+    assert att["unattributed_pct"] <= 10.0, att
+    assert att["attributed_ms"] <= att["wall_ms"] + 1.0, att
+    assert "host_sort" in att["buckets"]
+    # the spilled variant's host drains are attributed too (bigger
+    # corpus: the wall must be dominated by measured work, not the
+    # fixed per-job framework overhead a 100ms job is mostly made of)
+    n2 = 1_000_000
+    inp2 = _records(tmp_path, "recs2.npy",
+                    rng.integers(0, 1 << 62, n2, dtype=np.uint64),
+                    rng.integers(0, 1 << 63, n2, dtype=np.uint64))
+    cfg2 = _cfg(tmp_path, inp2, "att2.bin", 1,
+                chunk_bytes=16 * 65536, batch_size=1 << 16,
+                collect_max_rows=100_000,
+                metrics_out=str(tmp_path / "m2.json"))
+    run_job(cfg2, "sort")
+    att2 = json.load(open(tmp_path / "m2.json"))["attrib"]
+    assert att2["unattributed_pct"] <= 10.0, att2
+    assert att2["buckets"]["host_sort"]["ms"] > 0.0
+
+
+# --- hash equi-join ---------------------------------------------------------
+
+
+@pytest.mark.parametrize("shards", [1, 8])
+def test_join_oracle_exact(tmp_path, shards):
+    rng = np.random.default_rng(5)
+    na, nb = 3000, 2500
+    ka = rng.integers(0, 500, na, dtype=np.uint64)
+    pa = rng.integers(0, 1 << 40, na, dtype=np.uint64)
+    kb = rng.integers(0, 500, nb, dtype=np.uint64)
+    pb = rng.integers(0, 1 << 40, nb, dtype=np.uint64)
+    a = _records(tmp_path, "a.npy", ka, pa)
+    b = _records(tmp_path, "b.npy", kb, pb)
+    r = run_job(_cfg(tmp_path, a, f"j{shards}.bin", shards,
+                     join_input_path=b), "join")
+    gk, ga, gb = read_join_records(tmp_path / f"j{shards}.bin")
+    wk, wa, wb = join_model(ka, pa, kb, pb)
+    assert np.array_equal(gk, wk)
+    assert np.array_equal(ga, wa)
+    assert np.array_equal(gb, wb)
+    assert r.n_matches == wk.shape[0]
+    assert (r.n_left, r.n_right) == (na, nb)
+
+
+def test_join_disjoint_keys_no_matches(tmp_path):
+    ka = np.arange(0, 100, dtype=np.uint64)
+    kb = np.arange(1000, 1100, dtype=np.uint64)
+    a = _records(tmp_path, "a.npy", ka, ka)
+    b = _records(tmp_path, "b.npy", kb, kb)
+    r = run_job(_cfg(tmp_path, a, "j0.bin", 8, join_input_path=b),
+                "join")
+    assert r.n_matches == 0
+    gk, _ga, _gb = read_join_records(tmp_path / "j0.bin")
+    assert gk.shape == (0,)
+
+
+def test_join_payload_side_bit_refused(tmp_path):
+    ka = np.array([1], np.uint64)
+    pa = np.array([1 << 63], np.uint64)  # steals the side bit
+    a = _records(tmp_path, "a.npy", ka, pa)
+    b = _records(tmp_path, "b.npy", ka, ka)
+    with pytest.raises(Exception, match="2\\*\\*63"):
+        run_job(_cfg(tmp_path, a, "", 1, join_input_path=b), "join")
+
+
+def test_join_requires_right_corpus(tmp_path):
+    a = _records(tmp_path, "a.npy", np.array([1], np.uint64))
+    with pytest.raises(ValueError, match="join-input"):
+        run_job(_cfg(tmp_path, a, "", 1), "join")
+
+
+# --- sessionize -------------------------------------------------------------
+
+
+@pytest.mark.parametrize("shards", [1, 8])
+def test_sessionize_oracle_exact(tmp_path, shards):
+    rng = np.random.default_rng(6)
+    ne = 4000
+    ek = rng.integers(0, 200, ne, dtype=np.uint64)
+    ts = rng.integers(0, 100_000, ne, dtype=np.uint64)
+    inp = _records(tmp_path, "ev.npy", ek, ts)
+    gap = 500
+    r = run_job(_cfg(tmp_path, inp, f"se{shards}.txt", shards,
+                     session_gap=gap), "sessionize")
+    rows = [tuple(int(x) for x in line.split("\t")) for line in
+            open(tmp_path / f"se{shards}.txt").read().splitlines()]
+    mk, ms, me, mc = sessionize_model(ek, ts, gap)
+    want = list(zip(mk.tolist(), ms.tolist(), me.tolist(), mc.tolist()))
+    assert rows == want
+    assert r.n_sessions == len(want)
+    assert r.n_events == ne  # conservation rides the driver check too
+
+
+def test_sessionize_gap_boundary_semantics(tmp_path):
+    """A gap EXACTLY equal to session_gap stays one session; one unit
+    more cuts — pinned on both the model and the engine path."""
+    ek = np.zeros(4, np.uint64)
+    ts = np.array([0, 500, 1001, 1501], np.uint64)
+    inp = _records(tmp_path, "ev.npy", ek, ts)
+    r = run_job(_cfg(tmp_path, inp, "gb.txt", 1, session_gap=500),
+                "sessionize")
+    rows = [tuple(int(x) for x in line.split("\t")) for line in
+            open(tmp_path / "gb.txt").read().splitlines()]
+    # 0->500 within gap; 500->1001 cuts (501 > 500); 1001->1501 within
+    assert rows == [(0, 0, 500, 2), (0, 1001, 1501, 2)]
+    assert r.n_sessions == 2
+
+
+def test_cli_tolerates_downstream_pipe_closure(tmp_path):
+    """``python -m map_oxidize_tpu obs where doc.json | head`` is the
+    documented way to skim the reports (check.sh drives them exactly so
+    under pipefail): a consumer that closes the pipe early must read as
+    success, not a BrokenPipeError traceback.  The reader end is closed
+    BEFORE the child spawns, so the first flush hits EPIPE
+    deterministically."""
+    import os as _os
+    import subprocess
+    import sys as _sys
+
+    from map_oxidize_tpu.obs import attrib
+
+    doc = {"attrib": {
+        "schema": attrib.ATTRIB_SCHEMA, "wall_ms": 1000.0,
+        "attributed_ms": 990.0, "unattributed_ms": 10.0,
+        "unattributed_pct": 1.0,
+        "buckets": {b: {"ms": 90.0, "pct": 9.0} for b in attrib.BUCKETS},
+    }, "meta": {"workload": "sort"}}
+    path = tmp_path / "m.json"
+    path.write_text(json.dumps(doc))
+    r, w = _os.pipe()
+    _os.close(r)  # the reader is already gone
+    try:
+        proc = subprocess.run(
+            [_sys.executable, "-m", "map_oxidize_tpu", "obs", "where",
+             str(path)],
+            stdout=w, stderr=subprocess.PIPE,
+            cwd=_os.path.dirname(_os.path.dirname(
+                _os.path.abspath(__file__))))
+    finally:
+        _os.close(w)
+    assert proc.returncode == 0, proc.stderr.decode()
+    assert b"Traceback" not in proc.stderr
+
+
+# --- allowlists: one source of truth ---------------------------------------
+
+
+def test_workload_allowlists_agree():
+    """The one-shot CLI, the serve scheduler, and the submit CLI all
+    derive their workload choices from ``config.WORKLOADS`` — no
+    hand-maintained list can drift, and the three new dataflow
+    workloads appear everywhere at once."""
+    import argparse
+
+    from map_oxidize_tpu.cli import build_parser
+    from map_oxidize_tpu.config import SERVE_WORKLOADS, WORKLOADS
+    from map_oxidize_tpu.serve.cli import build_submit_parser
+
+    for w in ("sort", "join", "sessionize"):
+        assert w in WORKLOADS
+    assert tuple(SERVE_WORKLOADS) == tuple(WORKLOADS)
+
+    def _choices(parser, dest):
+        for action in parser._actions:
+            if action.dest == dest and not isinstance(
+                    action, argparse._VersionAction):
+                return tuple(action.choices)
+        raise AssertionError(f"no {dest} positional")
+
+    assert _choices(build_parser(), "workload") == tuple(WORKLOADS)
+    assert _choices(build_submit_parser(), "workload") == tuple(
+        SERVE_WORKLOADS)
