@@ -612,7 +612,7 @@ def _metrics_snapshot(result) -> dict:
                              "feed_block_ms/", "compile/", "xprof/",
                              "device/", "hbm/", "comms/", "heartbeat/",
                              "dispatch/", "alerts/", "attrib/",
-                             "profile/", "calib/"))}
+                             "profile/", "calib/", "critpath/"))}
     return snap
 
 

@@ -465,6 +465,22 @@ class Obs:
         attrib_doc = _attrib.finalize(
             self, xprof_report,
             max(_time.time() - self.tracer.wall_start, 1e-9))
+        # the causal layer's single-process form: the critical path
+        # degenerates to the attribution timeline, but the SAME headline
+        # gauges (critpath/bound_frac, path coverage, bound_by) land in
+        # the summary -> ledger entry, so trend/gate watch one axis
+        # across single- and multi-process runs.  The resident server's
+        # own bundle idles between jobs — no job wall to decompose.
+        critpath_doc = None
+        if workload != "serve":
+            from map_oxidize_tpu.obs import critpath as _critpath
+
+            try:
+                critpath_doc = _critpath.degenerate_from_attrib(
+                    attrib_doc, process=self.process)
+                _critpath.publish(self.registry, critpath_doc)
+            except ValueError:
+                pass
         self._merge_calibration(xprof_report)
         sample_host_memory(self.registry)
         sample_device_memory(self.registry)
@@ -474,6 +490,8 @@ class Obs:
         if config.metrics_out:
             doc = dict(self.registry.to_dict(), meta=meta)
             doc["attrib"] = attrib_doc
+            if critpath_doc is not None:
+                doc["critpath"] = critpath_doc
             if xprof_report is not None:
                 doc["xprof"] = xprof_report
             if self.series is not None:

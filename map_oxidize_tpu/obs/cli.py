@@ -1,12 +1,23 @@
 """``python -m map_oxidize_tpu obs ...`` — observability artifact tools.
 
-Nine subcommands, all pure host-side work (no jax, no backend init):
+Ten subcommands, all pure host-side work (no jax, no backend init):
 
 * ``obs merge`` — combine a distributed run's per-process trace shards
   (``<trace_out>.proc<i>``) into one Chrome trace (pid = process slot)
-  plus a skew/straggler report.  Process 0 does this automatically at
-  job end when the shards share a filesystem; this command covers the
-  copied-from-isolated-hosts case and re-merges.
+  plus a skew/straggler report (now carrying ``coverage`` and
+  ``critpath`` sections).  Process 0 does this automatically at job end
+  when the shards share a filesystem; this command covers the
+  copied-from-isolated-hosts case and re-merges.  Torn/missing shards
+  yield a post-mortem merge with a NAMED coverage gap; wall-clock skew
+  past the alignment bound refuses (``--allow-clock-skew`` overrides).
+* ``obs critpath`` — the causal critical-path report
+  (:mod:`map_oxidize_tpu.obs.critpath`): which chain of spans, feed
+  waits, and lockstep collective rounds across ALL processes set
+  end-to-end wall — per-process blame shares, slack (how much each
+  off-path process could slow for free), and what-if estimators
+  ("proc 1 at peer-median speed => wall −31%").  Reads a trace base
+  with shards, a merged trace, a skew report, a metrics document, a
+  crash bundle, or ``--archive`` fleet post-mortems.
 * ``obs diff`` — compare two entries of a run ledger
   (``--ledger-dir``'s ``ledger.jsonl``): per-phase and per-counter
   deltas, identity-checked (workload, config hash, version) so
@@ -85,6 +96,37 @@ def build_obs_parser() -> argparse.ArgumentParser:
                    help="merged Chrome trace path (default: the base path)")
     m.add_argument("--skew-out", default=None,
                    help="skew report path (default: <out>.skew.json)")
+    m.add_argument("--allow-clock-skew", action="store_true",
+                   help="merge even when the lockstep rounds do not "
+                        "overlap after wall-clock alignment (forensics "
+                        "on hosts with known-bad clocks; cross-process "
+                        "ordering may be wrong)")
+
+    cp = sub.add_parser(
+        "critpath", help="causal critical-path report: which chain of "
+                         "spans, feed waits, and lockstep collective "
+                         "rounds across ALL processes set end-to-end "
+                         "wall — per-process blame shares, slack, and "
+                         "what-if estimators")
+    cp.add_argument("source", nargs="?", default=None,
+                    help="a run's --trace-out base (its .proc<i> shards "
+                         "are used), a merged Chrome trace, a skew "
+                         "report, a --metrics-out document, or a "
+                         "flight-recorder crash bundle directory (omit "
+                         "with --archive)")
+    cp.add_argument("--archive", default=None, metavar="DIR",
+                    help="a fleet series archive (obs fleet "
+                         "--archive-dir): render each archived "
+                         "target's critical path post-mortem — works "
+                         "after every producer process exited")
+    cp.add_argument("--target", default=None,
+                    help="with --archive: only this target label "
+                         "(host:port)")
+    cp.add_argument("--allow-clock-skew", action="store_true",
+                    help="compute even when shard wall clocks disagree "
+                         "past the alignment bound")
+    cp.add_argument("--json", action="store_true",
+                    help="emit the structured critpath document")
 
     d = sub.add_parser(
         "diff", help="diff two ledger entries (per-phase/per-counter "
@@ -294,7 +336,125 @@ def obs_main(argv: list[str]) -> int:
         return _calib(args)
     if args.cmd == "fleet":
         return _fleet(args)
+    if args.cmd == "critpath":
+        return _critpath(args)
     return _diff(args)
+
+
+def _critpath_doc_from_source(source: str, allow_clock_skew: bool):
+    """Resolve an ``obs critpath`` source argument to a critpath
+    document.  Accepts, in probe order: a trace base with ``.proc<i>``
+    shards next to it (fresh extraction, torn shards tolerated), a
+    single shard document, a merged Chrome trace (event list), a skew
+    report carrying a ``critpath`` section, and a metrics document /
+    crash bundle (its stored section, else the attribution timeline).
+    Returns ``(doc, title)`` or raises ``ValueError``."""
+    import json
+
+    from map_oxidize_tpu.obs import critpath, merge
+
+    shard_paths = merge.find_shards(source)
+    if shard_paths:
+        shards, torn = merge.read_shards_tolerant(shard_paths)
+        if not shards:
+            raise ValueError(
+                f"no readable shards at {source}.proc* "
+                f"(torn: {[t['path'] for t in torn]})")
+        cov = merge.coverage_report(shards, torn)
+        doc = critpath.compute_from_shards(
+            shards, coverage=cov, check_clock=not allow_clock_skew)
+        wl = shards[0].get("meta", {}).get("workload")
+        return doc, f"critical path — {wl or '?'} ({len(shards)} shards)"
+    path = resolve_metrics_path(source)
+    with open(path) as f:
+        loaded = json.load(f)
+    if isinstance(loaded, list):
+        # a merged Chrome trace: pid = process slot, already aligned
+        return (critpath.compute_from_merged_events(loaded),
+                "critical path — merged trace")
+    if not isinstance(loaded, dict):
+        raise ValueError(f"{path!r} is not a critpath source")
+    if loaded.get("schema") == merge.SHARD_SCHEMA:
+        meta = loaded.get("meta", {})
+        return (critpath.compute_from_shards([loaded]),
+                f"critical path — proc {meta.get('process')} shard only")
+    stored = loaded.get("critpath")
+    if stored and not stored.get("error"):
+        wl = (loaded.get("meta") or {}).get("workload")
+        return stored, f"critical path — {wl or '?'} (stored)"
+    attrib_doc = loaded.get("attrib")
+    if attrib_doc:
+        wl = (loaded.get("meta") or {}).get("workload")
+        return (critpath.degenerate_from_attrib(attrib_doc),
+                f"critical path — {wl or '?'} (attrib timeline)")
+    raise ValueError(
+        f"{path!r} carries neither trace shards, a merged trace, a "
+        "critpath section, nor an attrib section")
+
+
+def _critpath(args) -> int:
+    import json
+
+    from map_oxidize_tpu.obs import critpath
+
+    if args.archive:
+        # post-mortem: archived per-target /status snapshots carry the
+        # critpath headline and the attribution each path degenerates
+        # onto — readable after every producer process exited
+        from map_oxidize_tpu.obs.fleet import ArchiveMismatch, SeriesArchive
+
+        try:
+            snap = SeriesArchive.latest(args.archive, "targets")
+        except ArchiveMismatch as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        targets = (snap or {}).get("targets") or {}
+        if args.target is not None:
+            targets = {k: v for k, v in targets.items()
+                       if k == args.target}
+        docs = {}
+        for label, st in sorted(targets.items()):
+            if not isinstance(st, dict):
+                continue
+            try:
+                docs[label] = critpath.degenerate_from_attrib(
+                    st.get("attrib"))
+                cp = st.get("critpath") or {}
+                if cp.get("bound_by"):
+                    docs[label]["bound_by"] = cp["bound_by"]
+            except ValueError:
+                continue
+        if not docs:
+            print("error: no archived target attribution"
+                  + (f" for {args.target!r}" if args.target else "")
+                  + f" under {args.archive!r}", file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps(docs, indent=1, sort_keys=True))
+            return 0
+        for label, doc in docs.items():
+            print(critpath.render(
+                doc, title=f"critical path — {label} (archived)"))
+        return 0
+    if not args.source:
+        print("error: obs critpath needs a source (trace base, merged "
+              "trace, metrics document, crash bundle) or --archive",
+              file=sys.stderr)
+        return 2
+    try:
+        doc, title = _critpath_doc_from_source(args.source,
+                                               args.allow_clock_skew)
+    except critpath.ClockSkewError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 3
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(doc, indent=1, sort_keys=True))
+        return 0
+    print(critpath.render(doc, title=title))
+    return 0
 
 
 def _fleet(args) -> int:
@@ -542,16 +702,28 @@ def _merge(args) -> int:
         return 2
     out = args.out if args.out else args.base
     try:
-        skew = merge_to_files(shards, out, args.skew_out)
+        skew = merge_to_files(shards, out, args.skew_out,
+                              allow_clock_skew=args.allow_clock_skew)
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
     skew_path = args.skew_out if args.skew_out else out + ".skew.json"
-    print(f"merged {len(shards)} shards -> {out}")
+    cov = skew.get("coverage") or {}
+    n_merged = len(cov.get("present_processes") or []) or len(shards)
+    print(f"merged {n_merged} shards -> {out}")
     print(f"skew report -> {skew_path}")
+    if cov.get("missing_processes") or cov.get("torn_shards"):
+        print(f"  !! coverage gap: missing process(es) "
+              f"{cov.get('missing_processes')}, torn shard(s) "
+              f"{cov.get('torn_shards')} — post-mortem merge over the "
+              "survivors")
     for r in skew["straggler_ranking"]:
         print(f"  proc {r['process']}: work {r['work_s']:.3f}s, "
               f"collective wait {r['collective_wait_s']:.3f}s")
+    cp = skew.get("critpath") or {}
+    if cp.get("bound_by"):
+        print(f"  critical path: bound by {cp['bound_by']} "
+              f"(obs critpath {args.base} for the full report)")
     return 0
 
 
@@ -787,6 +959,17 @@ def render_status(doc: dict) -> str:
         from map_oxidize_tpu.obs.attrib import render as render_attrib
 
         lines.append(render_attrib(at, title="where"))
+    cp = doc.get("critpath")
+    if cp and cp.get("bound_by"):
+        # the causal one-liner: what bounded the job, end to end
+        line = f"bound by: {cp['bound_by']}"
+        slack_ms = cp.get("top_process_slack_ms")
+        if isinstance(slack_ms, (int, float)) and slack_ms > 0:
+            line += f"  (top process slack {slack_ms / 1e3:.2f}s)"
+        cw = cp.get("collective_wait_share_pct")
+        if isinstance(cw, (int, float)) and cw > 0:
+            line += f"  collective-wait {cw:.1f}% of path"
+        lines.append(line)
     agg = doc.get("aggregate")
     if agg:
         lines.append(

@@ -46,6 +46,14 @@ _NON_IDENTITY_FIELDS = frozenset({
 
 LEDGER_FILE = "ledger.jsonl"
 
+#: ``obs diff --gate``: one process's blame share of the critical path
+#: rising by more than this (absolute share points, 0-1 scale) flags —
+#: a straggler concentrating is a regression even when wall holds
+CRITPATH_BLAME_GATE_POINTS = 0.15
+#: ... and the extracted path covering this much LESS of the wall flags
+#: as a causal-coverage regression (percentage points)
+CRITPATH_COVERAGE_GATE_POINTS = 10.0
+
 
 def config_identity(config) -> dict:
     """The identity-relevant config fields, as a JSON-stable dict."""
@@ -296,6 +304,36 @@ def diff_entries(a: dict, b: dict, threshold_pct: float = 10.0,
                 regressions.append(
                     f"{name}: {va_n:.1f}% -> {vb:.1f}% of wall "
                     "unattributed (attribution coverage regression)")
+        elif name == "critpath/top_blame_share":
+            # causal-layer gate: one process's share of the on-path work
+            # concentrating (fair share is 1/P) means a straggler grew —
+            # points of share, not relative percent, for the same reason
+            # the unattributed gate uses points (0.50 -> 0.55 is noise,
+            # 0.55 -> 0.85 is a straggler).  A MISSING baseline (a
+            # pre-critpath entry, or a run whose extraction errored) is
+            # unknown, not 0.0: the healthy floor is 1/P, so defaulting
+            # the baseline to zero would flag every first comparable
+            # run as a regression
+            if va != vb:
+                rows.append((name, va, vb, pct))
+            if (isinstance(va, (int, float))
+                    and isinstance(vb, (int, float))
+                    and vb - va > CRITPATH_BLAME_GATE_POINTS):
+                regressions.append(
+                    f"{name}: {va:.2f} -> {vb:.2f} of on-path work on "
+                    "one process (straggler concentration regression)")
+        elif name == "critpath/path_over_wall_pct":
+            # path-coverage gate: the extracted path reconciling to less
+            # of the wall means the causal model lost evidence (round
+            # tags stopped flowing, shards went missing) — a measurement-
+            # plane regression, like the unattributed gate
+            if va != vb:
+                rows.append((name, va, vb, pct))
+            if (isinstance(va, (int, float)) and isinstance(vb, (int, float))
+                    and va - vb > CRITPATH_COVERAGE_GATE_POINTS):
+                regressions.append(
+                    f"{name}: {va:.1f}% -> {vb:.1f}% of wall on the "
+                    "critical path (causal coverage regression)")
         elif name == "heartbeat/stalls":
             # stall episodes are evidence of a wedged feed loop or a
             # straggler-gated collective; ANY increase flags

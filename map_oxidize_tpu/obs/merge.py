@@ -72,6 +72,45 @@ def read_shard(path: str) -> dict:
     return doc
 
 
+def read_shards_tolerant(paths: list[str]
+                         ) -> "tuple[list[dict], list[dict]]":
+    """Read every shard that parses; a torn/garbage shard (a killed
+    process's partial write, a truncated copy) is collected as a NAMED
+    coverage gap instead of aborting the merge — a post-mortem must
+    reconstruct what survived, not refuse because something died.
+    Returns ``(shards, torn)`` where each torn row is
+    ``{"path", "error"}``."""
+    shards: list[dict] = []
+    torn: list[dict] = []
+    for p in paths:
+        try:
+            shards.append(read_shard(p))
+        except (OSError, ValueError) as e:
+            torn.append({"path": os.path.basename(p), "error": str(e)})
+            _log.warning("skipping torn obs shard %s: %s", p, e)
+    return shards, torn
+
+
+def coverage_report(shards: list[dict],
+                    torn: "list[dict] | None" = None) -> dict:
+    """The named coverage-gap document the skew/critpath reports carry:
+    which process slots the merge actually saw vs the job's declared
+    process count (a killed process writes no shard — its absence is
+    evidence, and must be NAMED, never silently averaged away)."""
+    present = sorted(int(s.get("meta", {}).get("process", 0))
+                     for s in shards)
+    expected = max([int(s.get("meta", {}).get("n_processes", 0) or 0)
+                    for s in shards] + [len(present)])
+    missing = sorted(set(range(expected)) - set(present))
+    cov = {"expected_processes": expected, "present_processes": present,
+           "missing_processes": missing,
+           "torn_shards": [t["path"] for t in (torn or [])]}
+    if missing or torn:
+        cov["note"] = ("post-mortem merge: statistics cover the "
+                       "surviving shards only")
+    return cov
+
+
 def find_shards(trace_out: str) -> list[str]:
     """Every ``<trace_out>.proc<i>`` next to the merged-output path,
     ordered by process slot."""
@@ -84,7 +123,8 @@ def find_shards(trace_out: str) -> list[str]:
     return sorted((p for p in paths if slot(p) < (1 << 30)), key=slot)
 
 
-def merge_shards(shards: list[dict]) -> tuple[list[dict], dict]:
+def merge_shards(shards: list[dict],
+                 allow_clock_skew: bool = False) -> tuple[list[dict], dict]:
     """Combine shard documents into ``(chrome_events, skew_report)``.
 
     The merged trace maps Chrome ``pid`` to the process slot and keeps
@@ -92,6 +132,17 @@ def merge_shards(shards: list[dict]) -> tuple[list[dict], dict]:
     anchored at the earliest shard's wall start.  Mixed-identity shards
     (different config hash / workload) refuse to merge — they are not
     one job.
+
+    Clock alignment is *asserted*, not assumed: each shard must carry a
+    usable monotone wall anchor (``wall_start_unix_s`` — the per-process
+    offsets it induces are uniform per shard, so intra-process event
+    order is preserved by construction), and the aligned lockstep
+    barrier rounds must overlap across processes — hosts whose wall
+    clocks disagree beyond
+    :data:`~map_oxidize_tpu.obs.critpath.CLOCK_SKEW_BOUND_S` refuse with
+    a named :class:`~map_oxidize_tpu.obs.critpath.ClockSkewError`
+    instead of silently mis-ordering every cross-process edge
+    (``allow_clock_skew`` overrides for forensics on known-bad clocks).
     """
     if not shards:
         raise ValueError("no shards to merge")
@@ -104,6 +155,14 @@ def merge_shards(shards: list[dict]) -> tuple[list[dict], dict]:
     seen = [m.get("process") for m in metas]
     if len(set(seen)) != len(seen):
         raise ValueError(f"duplicate process slots in shards: {seen}")
+    if not allow_clock_skew:
+        from map_oxidize_tpu.obs import critpath as _critpath
+
+        # anchor + barrier-overlap check (builds the per-process
+        # timelines; merge_to_files already holds them and passes
+        # allow_clock_skew=True after checking once itself)
+        _critpath.check_clock_alignment(
+            _critpath.timelines_from_shards(shards))
 
     anchor = min(float(m.get("wall_start_unix_s", 0.0)) for m in metas)
     out: list[dict] = []
@@ -187,14 +246,48 @@ def skew_report(shards: list[dict]) -> dict:
 
 
 def merge_to_files(shard_paths: list[str], trace_out: str,
-                   skew_out: str | None = None) -> dict:
+                   skew_out: str | None = None,
+                   allow_clock_skew: bool = False) -> dict:
     """Read shards, write the merged Chrome trace to ``trace_out`` and
-    the skew report next to it (``<trace_out>.skew.json`` by default).
-    Returns the skew report."""
+    the skew report — now carrying the ``coverage`` and ``critpath``
+    sections — next to it (``<trace_out>.skew.json`` by default).
+    Returns the skew report.
+
+    Tolerant by design: a torn shard (killed process) is skipped with a
+    named coverage gap, and the merge proceeds over what survived — the
+    post-mortem contract.  Only zero readable shards, mixed identity,
+    or wall-clock skew past the alignment bound abort (each with a
+    named error)."""
+    from map_oxidize_tpu.obs import critpath as _critpath
     from map_oxidize_tpu.obs import write_json_atomic
 
-    shards = [read_shard(p) for p in shard_paths]
-    events, skew = merge_shards(shards)
+    shards, torn = read_shards_tolerant(shard_paths)
+    if not shards:
+        raise ValueError(
+            f"no readable obs shards among {len(shard_paths)} path(s)"
+            + (f" (torn: {[t['path'] for t in torn]})" if torn else ""))
+    # identity/dup-slot refusal first (inside merge_shards), then ONE
+    # timeline build shared by the clock check and the critpath
+    # extraction — a large trace must not walk its events twice
+    events, skew = merge_shards(shards, allow_clock_skew=True)
+    timelines = _critpath.timelines_from_shards(shards)
+    if not allow_clock_skew:
+        _critpath.check_clock_alignment(timelines)
+    cov = coverage_report(shards, torn)
+    skew["coverage"] = cov
+    # the causal layer: critical path, blame, slack, what-if — an
+    # inextractable path (no round tags: a pre-critpath trace) is a
+    # named note, never a merge failure
+    try:
+        if len(timelines) == 1:
+            cp = _critpath.degenerate_from_attrib(
+                timelines[0].attrib, process=timelines[0].process)
+            cp["coverage"] = cov
+        else:
+            cp = _critpath.compute(timelines, coverage=cov)
+        skew["critpath"] = cp
+    except ValueError as e:
+        skew["critpath"] = {"error": str(e)}
     write_json_atomic(trace_out, events, indent=None)
     if skew_out is None:
         skew_out = trace_out + ".skew.json"

@@ -318,6 +318,15 @@ def build_status(obs, config, workload: str | None = None) -> dict:
             doc["attrib"] = attrib.compute(obs)
         except Exception:  # a decomposition bug must not break /status
             pass
+    # the causal headline (obs top's one-line "bound by" panel): the
+    # critpath/* gauges land post-merge (distributed proc 0) or at
+    # finish (single process) — archived /status snapshots carry them,
+    # so the fleet post-mortem readers can answer "what bounded it"
+    cp = {k[len("critpath/"):]: v
+          for k, v in obs.registry.gauges.items()
+          if k.startswith("critpath/")}
+    if cp:
+        doc["critpath"] = cp
     # open span stacks (what the job is doing RIGHT NOW), when tracing
     if obs.tracer.enabled:
         stacks = []

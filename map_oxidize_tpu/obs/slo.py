@@ -216,6 +216,24 @@ DEFAULT_RULES: tuple[dict, ...] = (
      "op": ">", "threshold": 20e9, "window_s": 30, "for_s": 10,
      "severity": "warning",
      "description": "sustained collective payload rate above 20 GB/s"},
+    # causal straggler alarm: fixing ONE process (the what-if "at
+    # peer-median speed" replay) would cut the wall by more than 30% —
+    # the process's blame share of the wall, measured causally.  Raw
+    # path ownership is deliberately NOT the trigger: near-tied
+    # arrivals put ~100% ownership on a coin-flip binder even on
+    # healthy runs, while the replay saving is ~0 on a tie and large
+    # only when a straggler is genuinely ON the critical path.  The
+    # gauge is published ONLY for multi-process runs (post-merge, which
+    # takes one final series sample + evaluator tick), so a single-chip
+    # job can never trip this; a firing lands in the ledger's
+    # alerts/fired gate counter + an incident bundle.
+    {"name": "critpath-process-blame",
+     "metric": "critpath/straggler_save_frac", "kind": "value",
+     "op": ">", "threshold": 0.30, "scope": "job",
+     "severity": "warning",
+     "description": "one process's blame share of the wall exceeds 30% "
+                    "(straggler on the critical path — see obs "
+                    "critpath for blame/slack/what-if)"},
 )
 
 

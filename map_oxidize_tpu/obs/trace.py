@@ -13,6 +13,16 @@ Disabled tracers hand out one shared no-op span object; the per-site cost
 of an un-traced run is a single attribute check, which is how the job
 keeps its <2% flags-off overhead budget.
 
+Causally-paired spans carry sequence tags in their args — ``round=<k>``
+on the distributed drivers' lockstep flag/exchange spans (round *k* is
+one cross-process barrier) and ``seq=<n>`` on the pipeline's
+producer/consumer queue-handoff spans — so the critical-path analyzer
+(:mod:`map_oxidize_tpu.obs.critpath`) joins happens-before edges by tag
+equality instead of timestamp heuristics.  The tags are plain loop
+counters at the call sites: lockstep rounds advance identically on every
+process by construction, which is what makes the cross-process join
+sound.
+
 Open the exported file at ``chrome://tracing`` or https://ui.perfetto.dev
 (see docs/OBSERVABILITY.md).
 """

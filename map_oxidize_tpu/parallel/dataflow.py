@@ -79,14 +79,20 @@ def _record_source(config: JobConfig, obs: Obs, proc: int, n_proc: int,
         base += end * 16
 
 
-def _lockstep_feed(obs: Obs, engine, source):
+def _lockstep_feed(obs: Obs, engine, source, round_base: int = 0):
     """Drive one lockstep feed loop to exhaustion ACROSS processes:
     stage this process's blocks, psum the continue flag each round with
     the actual staged row count riding it (the synchronized global count
     the disk demotion trips on), pop ``local_rows`` per round into
     ``merge_local``.  Returns ``(records, flag_rounds)`` — the flag
     WAIT itself is recorded by ``any_remaining`` into the
-    ``dist/flag_wait_ms`` histogram the attribution ledger reads."""
+    ``dist/flag_wait_ms`` histogram the attribution ledger reads.
+
+    ``round_base`` offsets the ``round=`` sequence tags on the flag and
+    exchange spans (the happens-before barrier tags
+    :mod:`map_oxidize_tpu.obs.critpath` joins on): the join's SECOND
+    feed loop passes the first loop's round count so the tags stay
+    globally unique and lockstep-aligned across both corpora."""
     from map_oxidize_tpu.ops.hashing import split_u64
 
     staged: list = []
@@ -105,7 +111,8 @@ def _lockstep_feed(obs: Obs, engine, source):
             staged_rows += int(k.shape[0])
             records += int(k.shape[0])
         have = staged_rows > 0
-        with obs.tracer.span("dist/lockstep_flag"):
+        with obs.tracer.span("dist/lockstep_flag",
+                             round=round_base + flag_rounds):
             cont = engine.any_remaining(
                 have, rows=min(staged_rows, engine.local_rows))
         flag_rounds += 1
@@ -129,7 +136,8 @@ def _lockstep_feed(obs: Obs, engine, source):
         # (compile, dispatch gaps, sampled waits, spill I/O) is the
         # blocking fetch of the routed block + global-array assembly —
         # consumer-visible device time the attribution ledger must see
-        with obs.tracer.span("dist/merge_local", rows=take):
+        with obs.tracer.span("dist/merge_local", rows=take,
+                             round=round_base + flag_rounds - 1):
             with device_wait_window(obs):
                 engine.merge_local(hi, lo, vals)
     return records, flag_rounds
@@ -305,7 +313,8 @@ def _run_distributed_join(config: JobConfig, obs: Obs) -> JoinResult:
             obs, engine, _record_source(config, obs, proc, P_,
                                         [(config.join_input_path,
                                           _doc_fn(True))],
-                                        base_off=left_rows * 16))
+                                        base_off=left_rows * 16),
+            round_base=fr_a)
     records = rec_a + rec_b
 
     with obs.phase("merge"):

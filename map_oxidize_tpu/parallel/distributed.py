@@ -1051,7 +1051,11 @@ def _run_distributed_core(config: JobConfig, workload: str, obs: Obs
                 records += out.records_in
             have = staged > 0
             t0 = _time.perf_counter()
-            with obs.tracer.span("dist/lockstep_flag"):
+            # round= is the lockstep sequence tag: every process runs
+            # the same rounds in the same order, so round k's flag spans
+            # across processes are ONE barrier — the cross-process edge
+            # the critical-path DAG (obs/critpath.py) is built from
+            with obs.tracer.span("dist/lockstep_flag", round=flag_rounds):
                 if doc_mode:
                     # contribute the actual block size: the replicated
                     # sum is then the GLOBAL rows entering this round —
@@ -1066,7 +1070,8 @@ def _run_distributed_core(config: JobConfig, workload: str, obs: Obs
                 break
             blk = _pop_block()
             with obs.tracer.span("dist/merge_local",
-                                 rows=int(blk[0].shape[0])):
+                                 rows=int(blk[0].shape[0]),
+                                 round=flag_rounds - 1):
                 engine.merge_local(*blk)
 
     if doc_mode and getattr(engine, "spilled", False):
@@ -1247,6 +1252,31 @@ def finish_distributed_obs(obs: Obs, config: JobConfig, workload: str
             _log.warning("obs shard barrier/merge failed (%s); merge by "
                          "hand: python -m map_oxidize_tpu obs merge %s",
                          e, config.trace_out)
+    critpath_doc = (skew or {}).get("critpath")
+    if critpath_doc and not critpath_doc.get("error"):
+        # the causal headline: critpath/* gauges land BEFORE the summary
+        # below, so the ledger entry (and obs diff --gate / obs trend)
+        # carries them; process 0's metrics document gains the full
+        # section (obs critpath reads it); one extra series sample +
+        # SLO tick lets the critpath-process-blame rule see the final
+        # figures (the evaluator otherwise stopped before the merge)
+        from map_oxidize_tpu.obs import critpath as _critpath
+
+        _critpath.publish(obs.registry, critpath_doc)
+        if config.metrics_out:
+            metrics_doc["critpath"] = critpath_doc
+            metrics_doc["gauges"] = dict(
+                metrics_doc.get("gauges") or {},
+                **_critpath.headline(critpath_doc))
+            write_json_atomic(f"{config.metrics_out}.proc{obs.process}",
+                              metrics_doc)
+        try:
+            if obs.series is not None:
+                obs.series.sample_once()
+            if obs.alerts is not None:
+                obs.alerts.evaluate_once()
+        except Exception:  # evidence, never a job failure
+            pass
     summary = obs.registry.summary()
     if obs.process == 0 and getattr(config, "ledger_dir", None):
         from map_oxidize_tpu.obs import ledger
@@ -1255,6 +1285,17 @@ def finish_distributed_obs(obs: Obs, config: JobConfig, workload: str
         if skew:
             extra = {"records_total": skew.get("records_total"),
                      "skew": skew.get("skew")}
+        if critpath_doc and not critpath_doc.get("error"):
+            # the compact causal summary (full segments stay in the
+            # skew report next to the merged trace)
+            extra["critpath"] = {
+                "bound_by": critpath_doc.get("bound_by"),
+                "path_over_wall_pct":
+                    critpath_doc.get("path_over_wall_pct"),
+                "blame": critpath_doc.get("blame"),
+                "slack": critpath_doc.get("slack"),
+                "what_if": critpath_doc.get("what_if"),
+            }
         comms = obs.registry.comms_table()
         if comms:
             extra["comms"] = comms

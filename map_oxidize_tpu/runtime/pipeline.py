@@ -113,13 +113,29 @@ class ChunkPrefetcher:
     # --- producer ---------------------------------------------------------
 
     def _produce(self) -> None:
+        tracer = (self._obs.tracer if self._obs is not None else None)
+        seq = 0
         try:
             while not self._stop:
                 t0 = time.perf_counter()
-                try:
-                    item = next(self._it)
-                except StopIteration:
+                # the producer half of the queue handoff: seq= pairs
+                # this span with the consumer's same-seq feed_wait span,
+                # the producer->consumer edge the critical-path DAG
+                # (obs/critpath.py) follows when the consumer stalled on
+                # this item.  Exhaustion uses the sentinel default so no
+                # StopIteration crosses the span (an error-tagged span
+                # in every healthy trace would read as a failure)
+                if tracer is not None and tracer.enabled:
+                    with tracer.span(f"{self._name}/produce",
+                                     seq=seq) as sp:
+                        item = next(self._it, _DONE)
+                        if item is _DONE:
+                            sp.set(exhausted=True)
+                else:
+                    item = next(self._it, _DONE)
+                if item is _DONE:
                     return
+                seq += 1
                 self.produce_s += time.perf_counter() - t0
                 # timed put loop instead of a blocking put: an abandoned
                 # consumer only drains once, so a producer stuck in a
@@ -172,10 +188,21 @@ class ChunkPrefetcher:
 
     def __iter__(self) -> Iterator[T]:
         self._thread.start()
+        tracer = (self._obs.tracer if self._obs is not None else None)
+        seq = 0
         try:
             while True:
                 t0 = time.perf_counter()
-                item = self._q.get()
+                if tracer is not None and tracer.enabled:
+                    # the consumer half of the handoff: the span's wall
+                    # IS the stall waiting for item seq (zero when the
+                    # producer ran ahead) — same-seq as the producer's
+                    # produce span
+                    with tracer.span(f"{self._name}/feed_wait", seq=seq):
+                        item = self._q.get()
+                else:
+                    item = self._q.get()
+                seq += 1
                 self.wait_s += time.perf_counter() - t0
                 if item is _DONE:
                     if self._err is not None:

@@ -232,6 +232,81 @@ EOF
 # obs where renders the sort decomposition from the metrics doc
 python -m map_oxidize_tpu obs where "$smoke/sort_metrics.json.proc0"
 
+echo "== critpath smoke =="
+# ISSUE-15: the causal critical-path observatory end to end — a 2-proc
+# wordcount with trace + ledger + live obs servers publishing into a
+# private well-known spool while a fleet collector archives in the
+# background.  Afterwards: `obs critpath` renders from the trace base,
+# blame shares sum to ~100%, the path covers >= 90% of the traced wall,
+# the ledger entry carries the critpath/* gate fields, process 0's
+# metrics doc carries the full section, and the archived fleet
+# post-mortem renders via --archive after every process exited.
+cp_spool="$smoke/cp_spool"; cp_archive="$smoke/cp_archive"
+mkdir -p "$cp_spool"
+cp_port=$(python - <<'EOF'
+import socket
+s = socket.socket(); s.bind(("127.0.0.1", 0))
+print(s.getsockname()[1]); s.close()
+EOF
+)
+MOXT_OBS_SPOOL="$cp_spool" python -m map_oxidize_tpu obs fleet \
+    --discover-dir "$cp_spool" --interval 0.2 --iterations 200 \
+    --archive-dir "$cp_archive" > "$smoke/cp_fleet.log" 2>&1 &
+cp_fleet_pid=$!
+cp_pids=()
+for p in 0 1; do
+    MOXT_OBS_SPOOL="$cp_spool" JAX_PLATFORMS=cpu \
+        XLA_FLAGS=--xla_force_host_platform_device_count=4 \
+        timeout -k 10 600 \
+        python -m map_oxidize_tpu wordcount "$smoke/corpus_spill.txt" \
+        --output "$smoke/cp_out.txt" \
+        --batch-size 65536 --quiet --obs-port 0 \
+        --dist-coordinator "127.0.0.1:$cp_port" --dist-processes 2 \
+        --dist-process-id "$p" \
+        --trace-out "$smoke/cp_trace.json" \
+        --metrics-out "$smoke/cp_metrics.json" \
+        --ledger-dir "$smoke/cp_ledger" > /dev/null &
+    cp_pids+=($!)
+done
+cp_rc=0
+for pid in "${cp_pids[@]}"; do wait "$pid" || cp_rc=$?; done
+if [ "$cp_rc" -ne 0 ]; then
+    echo "critpath smoke: a 2-proc child failed (rc=$cp_rc)"
+    kill "$cp_fleet_pid" 2>/dev/null || true
+    exit "$cp_rc"
+fi
+sleep 1   # one more collector sweep archives the post-exit state
+kill "$cp_fleet_pid" 2>/dev/null || true
+wait "$cp_fleet_pid" 2>/dev/null || true
+python -m map_oxidize_tpu obs critpath "$smoke/cp_trace.json" | head -12
+python - "$smoke" <<'EOF'
+import json, sys
+d = sys.argv[1]
+skew = json.load(open(f"{d}/cp_trace.json.skew.json"))
+cp = skew["critpath"]
+assert not cp.get("error"), cp
+shares = [r["share_pct"] for r in cp["blame"].values()]
+assert abs(sum(shares) - 100.0) < 0.5, shares
+assert cp["path_over_wall_pct"] >= 90.0, cp["path_over_wall_pct"]
+assert cp["what_if"], "no what-if estimates"
+led = [json.loads(l) for l in open(f"{d}/cp_ledger/ledger.jsonl")]
+m = led[-1]["metrics"]
+for k in ("critpath/bound_frac", "critpath/top_blame_share",
+          "critpath/top_process_slack_ms",
+          "critpath/collective_wait_share_pct",
+          "critpath/path_over_wall_pct", "critpath/bound_by"):
+    assert k in m, f"ledger entry lacks {k}"
+md = json.load(open(f"{d}/cp_metrics.json.proc0"))
+assert md.get("critpath", {}).get("blame"), \
+    "proc0 metrics doc lacks the critpath section"
+print("critpath smoke OK: blame sums to 100%, path covers "
+      f"{cp['path_over_wall_pct']:.1f}% of wall, "
+      f"bound by {cp['bound_by']}")
+EOF
+# the archived fleet post-mortem path renders AFTER every producer
+# process exited (per-target, degenerating onto the archived attrib)
+python -m map_oxidize_tpu obs critpath --archive "$cp_archive" | head -8
+
 echo "== dispatch-floor smoke =="
 # scan-batched streamed k-means: a center-seeded corpus streams through
 # the device in 5 chunks/iteration at --dispatch-batch 4 (one full block
