@@ -246,6 +246,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="where SLO incident bundles land (series window "
                         "+ status snapshot per alert firing); default: "
                         "the --crash-dir, if any")
+    p.add_argument("--no-data-audit", action="store_true",
+                   help="disable the data-plane observatory (per-"
+                        "partition row-conservation audits, key-skew "
+                        "telemetry, data/* gauges — obs/dataplane.py); "
+                        "on by default, pure host-side accounting")
     p.add_argument("--profile-dir", default=None,
                    help="where on-demand POST /profile deep captures "
                         "land (jax.profiler device trace + host "
@@ -305,6 +310,7 @@ def config_from_args(args: argparse.Namespace) -> JobConfig:
         obs_spool=args.obs_spool,
         slo_rules=args.slo_rules,
         incident_dir=args.incident_dir,
+        data_audit=not args.no_data_audit,
         profile_dir=args.profile_dir,
         host_sample_hz=args.host_sample_hz,
         calib_dir=args.calib_dir,

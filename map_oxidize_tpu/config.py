@@ -171,6 +171,14 @@ class JobConfig:
     #: where alert incident bundles land (series window + /status
     #: snapshot per firing); None = the --crash-dir, if any
     incident_dir: str | None = None
+    #: data-plane observatory (obs/dataplane.py): per-partition row-
+    #: conservation audits (order-independent checksums across the
+    #: shuffle — a violation is a named hard failure), key-skew
+    #: telemetry (``data/imbalance_factor``, hot keys, HLL distinct
+    #: estimates), and reduction-ratio gauges.  Pure host-side
+    #: accounting; does not change any computed result (excluded from
+    #: the ledger config identity)
+    data_audit: bool = True
     #: deep-profiling plane (obs/profiler.py): where on-demand
     #: ``POST /profile`` captures land (device trace + host sampling
     #: stacks + profile.json).  None = next to the crash bundles /

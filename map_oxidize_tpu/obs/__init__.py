@@ -173,6 +173,13 @@ class Obs:
     #: ``--calib-dir`` — or when the store on disk refused to load
     calib: "object | None" = None
     calib_prior: "object | None" = None
+    #: data-plane observatory (obs/dataplane.py): the per-partition
+    #: row-conservation/skew audit the engines feed, created lazily by
+    #: the driver through :meth:`ensure_dataplane` (the partition count
+    #: is an engine fact the config alone doesn't know); None until
+    #: then, and always None when ``config.data_audit`` is off
+    dataplane: "object | None" = None
+    dataplane_enabled: bool = True
     #: first-phase latch: Obs.phase stamps ``attrib/setup_ms`` (wall
     #: from Obs creation to the first phase span) exactly once
     _setup_stamped: bool = False
@@ -226,7 +233,9 @@ class Obs:
                                emit=emit)
                 hb.silent = silent
         obs = cls(registry=MetricsRegistry(), tracer=tracer, heartbeat=hb,
-                  process=process, n_processes=n_processes)
+                  process=process, n_processes=n_processes,
+                  dataplane_enabled=bool(
+                      getattr(config, "data_audit", True)))
         # the XLA program observatory is always-on: compile counts, costs
         # and dispatch gaps accrue in the process-global ledger; the job
         # deltas against this baseline at finish (obs/compile.py)
@@ -306,6 +315,31 @@ class Obs:
                 get_logger(__name__).warning(
                     "calibration store refused to load: %s", e)
         return obs
+
+    def ensure_dataplane(self, n_partitions: int, conserves: bool = True):
+        """Create (once) and return the data-plane audit
+        (:class:`~map_oxidize_tpu.obs.dataplane.DataPlaneAudit`), or
+        None when ``config.data_audit`` disabled it.  Drivers call this
+        as soon as they know the effective partition count; engines and
+        transports then feed ``obs.dataplane`` directly."""
+        if not self.dataplane_enabled:
+            return None
+        if self.dataplane is None:
+            from map_oxidize_tpu.obs.dataplane import DataPlaneAudit
+
+            self.dataplane = DataPlaneAudit(n_partitions,
+                                            conserves=conserves)
+        return self.dataplane
+
+    def finish_dataplane(self) -> "dict | None":
+        """Publish the ``data/*`` gauges and return the structured audit
+        section (``doc["data"]``) — called by ``finish`` and its
+        distributed twin BEFORE the registry summary is taken, so the
+        ledger entry carries the gauges.  None when no audit ran."""
+        if self.dataplane is None:
+            return None
+        self.dataplane.publish(self.registry)
+        return self.dataplane.doc()
 
     def request_cancel(self, reason: str = "cancelled") -> None:
         """Ask the job to stop at its next cancellation point (phase
@@ -482,6 +516,9 @@ class Obs:
             except ValueError:
                 pass
         self._merge_calibration(xprof_report)
+        # the data-plane audit lands before the summary below, so the
+        # ledger entry (and obs diff --gate) carries the data/* gauges
+        data_doc = self.finish_dataplane()
         sample_host_memory(self.registry)
         sample_device_memory(self.registry)
         if self.heartbeat is not None:
@@ -492,6 +529,8 @@ class Obs:
             doc["attrib"] = attrib_doc
             if critpath_doc is not None:
                 doc["critpath"] = critpath_doc
+            if data_doc is not None:
+                doc["data"] = data_doc
             if xprof_report is not None:
                 doc["xprof"] = xprof_report
             if self.series is not None:
@@ -516,6 +555,10 @@ class Obs:
             comms = self.registry.comms_table()
             if comms:
                 extra["comms"] = comms
+            if data_doc is not None:
+                from map_oxidize_tpu.obs.dataplane import ledger_section
+
+                extra["data"] = ledger_section(data_doc)
             if self.alerts is not None and (self.alerts.fired_total
                                             or self.alerts.resolved_total):
                 # the alert timeline rides the entry (the flat

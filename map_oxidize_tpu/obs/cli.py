@@ -1,6 +1,6 @@
 """``python -m map_oxidize_tpu obs ...`` — observability artifact tools.
 
-Ten subcommands, all pure host-side work (no jax, no backend init):
+Eleven subcommands, all pure host-side work (no jax, no backend init):
 
 * ``obs merge`` — combine a distributed run's per-process trace shards
   (``<trace_out>.proc<i>``) into one Chrome trace (pid = process slot)
@@ -30,6 +30,12 @@ Ten subcommands, all pure host-side work (no jax, no backend init):
   directory: per-program compile counts with recompile causes,
   FLOPs/bytes from ``cost_analysis``, achieved-vs-peak utilization, and
   the dispatch-gap histogram summary.
+* ``obs data`` — render the data-plane observatory section
+  (:mod:`map_oxidize_tpu.obs.dataplane`) from a ``--metrics-out``
+  document, an obs shard, or a crash bundle: the row-conservation audit
+  table (rows/bytes/checksums per phase boundary), the per-partition
+  key-skew heatmap with the imbalance factor and hot keys, and the
+  reduction-ratio gauges (rows in vs distinct keys out).
 * ``obs trend`` — cross-run regression forensics over a run ledger (or
   ``BENCH_r*.json`` rounds): per-counter/per-phase trajectories, step-
   change detection against the median of prior entries, and a ranked
@@ -165,6 +171,21 @@ def build_obs_parser() -> argparse.ArgumentParser:
     x.add_argument("--json", action="store_true",
                    help="emit the structured report as JSON instead of "
                         "the rendered tables")
+
+    da = sub.add_parser(
+        "data", help="render the data-plane audit (row-conservation "
+                     "table, per-partition skew heatmap, reduction-ratio "
+                     "gauges) from a --metrics-out document or a crash "
+                     "bundle")
+    da.add_argument("metrics", help="a run's --metrics-out JSON, a "
+                                    "<metrics_out>.proc<i> shard document, "
+                                    "or a flight-recorder --crash-dir "
+                                    "bundle directory (its metrics.json "
+                                    "is used; a crash-dir root resolves "
+                                    "to the newest bundle)")
+    da.add_argument("--json", action="store_true",
+                    help="emit the structured audit section as JSON "
+                         "instead of the rendered tables")
 
     tr = sub.add_parser(
         "trend", help="cross-run regression forensics: per-counter/per-"
@@ -324,6 +345,8 @@ def obs_main(argv: list[str]) -> int:
         return _merge(args)
     if args.cmd == "xprof":
         return _xprof(args)
+    if args.cmd == "data":
+        return _data(args)
     if args.cmd == "top":
         return _top(args)
     if args.cmd == "trend":
@@ -689,6 +712,34 @@ def _xprof(args) -> int:
         print(json.dumps(report, indent=1, sort_keys=True))
         return 0
     print(render_report(report, histograms=doc.get("histograms")))
+    return 0
+
+
+def _data(args) -> int:
+    import json
+
+    from map_oxidize_tpu.obs.dataplane import render
+
+    path = resolve_metrics_path(args.metrics)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"error: cannot read metrics document {path!r}: {e}",
+              file=sys.stderr)
+        return 2
+    if doc.get("schema"):  # an obs shard: the metrics doc nests inside
+        doc = doc.get("metrics", {})
+    section = doc.get("data")
+    if not section:
+        print("error: no data section in this metrics document (produced "
+              "by a pre-audit version, or the run disabled it with "
+              "--no-data-audit)", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(section, indent=1, sort_keys=True))
+        return 0
+    print(render(section))
     return 0
 
 

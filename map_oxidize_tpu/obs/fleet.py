@@ -753,6 +753,12 @@ class FleetCollector:
             # fleet-hbm-watermark alert firing forever (the staleness
             # rule owns dead targets)
             m["hbm_frac"] = round(live / budget, 4) if t.up else 0.0
+        data = (t.status or {}).get("data") or {}
+        imb = data.get("imbalance_factor")
+        if isinstance(imb, (int, float)):
+            # key-skew rollup: only while the target publishes a
+            # data-plane section (same presence contract as hbm_frac)
+            m["imbalance_factor"] = round(float(imb), 4)
         return m
 
     def _publish_gauges(self, now: float) -> None:
@@ -760,10 +766,10 @@ class FleetCollector:
             rows = {t.label: (t, self._target_metrics(t, now))
                     for t in self.targets.values()}
         agg_rate = agg_queue = agg_jobs = agg_alerts = 0.0
-        hbm_max = 0.0
+        hbm_max = imb_max = 0.0
         n_up = n_stale = n_active = 0
         for label, (t, m) in rows.items():
-            for name in _TARGET_GAUGES + ("hbm_frac",):
+            for name in _TARGET_GAUGES + ("hbm_frac", "imbalance_factor"):
                 if name in m:
                     self.registry.set(f"fleet/target/{label}/{name}",
                                       m[name])
@@ -782,6 +788,7 @@ class FleetCollector:
             agg_jobs += m["jobs_running"]
             agg_alerts += m["alerts_firing"]
             hbm_max = max(hbm_max, m["hbm_bytes"])
+            imb_max = max(imb_max, m.get("imbalance_factor", 0.0))
         self.registry.set("fleet/targets", n_active)
         self.registry.set("fleet/targets_up", n_up)
         self.registry.set("fleet/targets_stale", n_stale)
@@ -790,6 +797,9 @@ class FleetCollector:
         self.registry.set("fleet/queue_depth", agg_queue)
         self.registry.set("fleet/jobs_running", agg_jobs)
         self.registry.set("fleet/target_alerts_firing", agg_alerts)
+        # the worst partition skew anywhere on the fleet — the number a
+        # fleet-scope skew SLO rule (or a capacity planner) watches
+        self.registry.set("fleet/imbalance_max", round(imb_max, 4))
 
     def _archive_sample(self, ts: float, snap: dict) -> None:
         # only the fleet's own series persist — per-target raw /status
@@ -832,6 +842,8 @@ class FleetCollector:
             }
             if "hbm_frac" in m:
                 row["hbm_frac"] = m["hbm_frac"]
+            if "imbalance_factor" in m:
+                row["imbalance_factor"] = m["imbalance_factor"]
             if t.last_error:
                 row["last_error"] = t.last_error
             rows.append(row)

@@ -39,7 +39,7 @@ _NON_IDENTITY_FIELDS = frozenset({
     "progress_interval_s", "ledger_dir", "crash_dir",
     "hbm_sample_s", "stall_warn_factor",
     "obs_port", "obs_sample_s", "obs_spool",
-    "slo_rules", "incident_dir",
+    "slo_rules", "incident_dir", "data_audit",
     "calib_dir", "profile_dir", "host_sample_hz",
     "dist_coordinator", "dist_process_id",
 })
@@ -53,6 +53,13 @@ CRITPATH_BLAME_GATE_POINTS = 0.15
 #: ... and the extracted path covering this much LESS of the wall flags
 #: as a causal-coverage regression (percentage points)
 CRITPATH_COVERAGE_GATE_POINTS = 10.0
+
+#: ``obs diff --gate``: the partition imbalance factor (max/mean rows,
+#: ``data/imbalance_factor``) rising by more than this absolute amount
+#: between same-identity runs flags — a routing/partitioning change
+#: concentrated load onto one partition (same-config corpora hash
+#: deterministically, so a rise is a code change, not noise)
+DATA_IMBALANCE_GATE_POINTS = 1.0
 
 
 def config_identity(config) -> dict:
@@ -334,6 +341,33 @@ def diff_entries(a: dict, b: dict, threshold_pct: float = 10.0,
                 regressions.append(
                     f"{name}: {va:.1f}% -> {vb:.1f}% of wall on the "
                     "critical path (causal coverage regression)")
+        elif name == "data/conservation_violations":
+            # data-plane hard gate: a conservation violation means rows
+            # were dropped, duplicated, or corrupted across the shuffle
+            # — ANY appearance flags, at any threshold (the run itself
+            # aborts with ConservationError; this catches the violation
+            # count in crash-bundle comparisons and audit-off baselines)
+            if va != vb:
+                rows.append((name, va, vb, pct))
+            va_n = va if isinstance(va, (int, float)) else 0
+            if isinstance(vb, (int, float)) and vb > va_n:
+                regressions.append(
+                    f"{name}: {va_n:g} -> {vb:g} row-conservation "
+                    "violations (data loss across the shuffle)")
+        elif name == "data/imbalance_factor":
+            # key-skew gate: max/mean partition rows rising by more than
+            # DATA_IMBALANCE_GATE_POINTS for the same config/corpus is a
+            # partitioning regression (points of factor, not relative
+            # percent: 1.1 -> 1.3 is hash noise across code changes,
+            # 1.3 -> 3.5 is one partition eating the job)
+            if va != vb:
+                rows.append((name, va, vb, pct))
+            if (isinstance(va, (int, float))
+                    and isinstance(vb, (int, float))
+                    and vb - va > DATA_IMBALANCE_GATE_POINTS):
+                regressions.append(
+                    f"{name}: {va:.2f} -> {vb:.2f} max/mean partition "
+                    "rows (key-skew regression)")
         elif name == "heartbeat/stalls":
             # stall episodes are evidence of a wedged feed loop or a
             # straggler-gated collective; ANY increase flags

@@ -16,6 +16,13 @@ unchanged, and adds what the scale targets need:
   count/mean/min/max plus a deterministic stride-decimated sample set
   for the quantiles.
 
+Gauge names are slash-namespaced by owning subsystem — ``spill/*``,
+``shuffle/*``, ``hbm/*``, ``critpath/*``, ``fleet/*``, and ``data/*``
+(the data-plane observatory: ``data/imbalance_factor``,
+``data/reduction_ratio``, ``data/conservation_violations``, ... — see
+:mod:`map_oxidize_tpu.obs.dataplane`).  The ledger diff gates, the SLO
+evaluator, the series ring, and ``/status`` all key off these names.
+
 All mutating entry points take one lock; contention is negligible at the
 per-chunk/per-flush cadence the hot paths record at.
 """

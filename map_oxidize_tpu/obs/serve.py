@@ -327,6 +327,28 @@ def build_status(obs, config, workload: str | None = None) -> dict:
           if k.startswith("critpath/")}
     if cp:
         doc["critpath"] = cp
+    # the data-plane headline (conservation, skew, reduction): either
+    # the live audit mid-run, or the published data/* gauges post-finish
+    dp = getattr(obs, "dataplane", None)
+    if dp is not None:
+        try:
+            d = dp.doc()
+            doc["data"] = {
+                "partitions": d["partitions"],
+                "rows_in": d["reduction"]["rows_in"],
+                "imbalance_factor": d["skew"]["imbalance_factor"],
+                "reduction_ratio": d["reduction"]["ratio"],
+                "conservation_violations":
+                    len(d["conservation"]["violations"]),
+            }
+        except Exception:  # an audit bug must not break /status
+            pass
+    else:
+        dg = {k[len("data/"):]: v
+              for k, v in obs.registry.gauges.items()
+              if k.startswith("data/")}
+        if dg:
+            doc["data"] = dg
     # open span stacks (what the job is doing RIGHT NOW), when tracing
     if obs.tracer.enabled:
         stacks = []

@@ -77,6 +77,7 @@ _SCOPES = ("any", "job", "serve", "fleet")
 _RULE_FIELDS = frozenset({
     "name", "metric", "kind", "op", "threshold", "window_s", "for_s",
     "after_s", "scope", "severity", "denominator", "description",
+    "evidence",
 })
 
 
@@ -104,6 +105,12 @@ class SloRule:
     #: the denominator series is absent or zero) — HBM watermark as a
     #: fraction of the admission budget, and friends
     denominator: str | None = None
+    #: cross-link: metric name(s) whose figures corroborate a firing —
+    #: rendered in incident bundles and /alerts so the responder reads
+    #: the corroborating gauge next to the trigger (e.g. the data-plane
+    #: skew rule cross-links the critpath straggler-save fraction:
+    #: a skewed partition should show up as a blamed process)
+    evidence: str = ""
     description: str = ""
 
     def validate(self) -> "SloRule":
@@ -138,6 +145,9 @@ class SloRule:
         if self.denominator is not None and self.kind != "value":
             raise ValueError(f"rule {self.name!r}: denominator only "
                              "applies to value rules")
+        if not isinstance(self.evidence, str):
+            raise ValueError(f"rule {self.name!r}: evidence must be a "
+                             f"metric-name string, got {self.evidence!r}")
         return self
 
     def holds(self, observed: float) -> bool:
@@ -234,6 +244,23 @@ DEFAULT_RULES: tuple[dict, ...] = (
      "description": "one process's blame share of the wall exceeds 30% "
                     "(straggler on the critical path — see obs "
                     "critpath for blame/slack/what-if)"},
+    # data-plane skew alarm: max/mean partition rows above 6x means the
+    # key distribution concentrates the shuffle onto a few partitions —
+    # the precondition for the straggler pattern the critpath plane
+    # blames, so the incident cross-links its save fraction as
+    # corroborating evidence (skewed partition <-> blamed process).
+    # 6.0 stays silent on healthy hash-partitioned corpora (measured
+    # smoke imbalance ~1-3x even on tiny vocabularies); an adversarial
+    # Zipf corpus trips it.  The gauge is published at audit finish
+    # (post-merge on distributed runs, like the critpath gauges).
+    {"name": "data-partition-skew", "metric": "data/imbalance_factor",
+     "kind": "value", "op": ">", "threshold": 6.0, "scope": "job",
+     "severity": "warning",
+     "evidence": "critpath/straggler_save_frac",
+     "description": "partition rows max/mean above 6x — key skew "
+                    "concentrating the shuffle on few partitions (see "
+                    "obs data for the heatmap; corroborate with the "
+                    "critpath straggler save fraction)"},
 )
 
 
@@ -562,6 +589,17 @@ class SloEvaluator:
                 "value": float(observed),
                 "t_unix_s": round(now, 3),
             }
+            if rule.evidence:
+                # the cross-linked corroborating metric, read at firing
+                # time (gauge first, series ring as fallback) — the
+                # responder sees e.g. the critpath straggler-save
+                # fraction right next to the skew trigger
+                ev_val = None
+                reg = getattr(self.obs, "registry", None)
+                if reg is not None:
+                    ev_val = reg.gauges.get(rule.evidence)
+                doc["evidence"] = {"metric": rule.evidence,
+                                   "value": ev_val}
             series_rec = getattr(self.obs, "series", None)
             if series_rec is not None:
                 export = series_rec.export()
@@ -605,6 +643,8 @@ class SloEvaluator:
                         "threshold": rule.threshold if rule else None,
                         "op": rule.op if rule else None,
                         "severity": rule.severity if rule else None,
+                        "evidence": (rule.evidence or None) if rule
+                                    else None,
                         "since_unix_s": round(cell.since_unix_s, 3),
                     })
                 per_rule.setdefault(rname, []).append(row)

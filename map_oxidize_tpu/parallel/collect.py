@@ -460,12 +460,19 @@ class ShardedCollectEngine:
                                     for x in self._sort(*self._buf)]
         keys_parts, docs_parts = [], []
         sent = np.uint32(SENTINEL)
+        observed = np.zeros(self.S, np.int64)
         for s in range(self.S):
             live = ~((s_hi[s] == sent) & (s_lo[s] == sent))
+            observed[s] = int(np.count_nonzero(live))
             keys_parts.append(
                 (s_hi[s][live].astype(np.uint64) << np.uint64(32))
                 | s_lo[s][live])
             docs_parts.append(
                 ((s_dhi[s][live].astype(np.uint64) << np.uint64(32))
                  | s_dlo[s][live]).view(np.int64))
+        dp = getattr(self.obs, "dataplane", None) if self.obs else None
+        if dp is not None:
+            # per-shard rows the device transport actually delivered —
+            # the measured twin of the audit's in-side hash histogram
+            dp.record_observed_rows(observed)
         return np.concatenate(keys_parts), np.concatenate(docs_parts)

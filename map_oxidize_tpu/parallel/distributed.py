@@ -805,6 +805,18 @@ def _spilled_invertedindex_result(config: JobConfig, obs, engine,
             [int(terms.shape[0]), int(offsets[-1])], np.int64), obs)
         n_keys = int(totals[:, 0].sum())
         n_pairs = int(totals[:, 1].sum())
+    dp = getattr(obs, "dataplane", None)
+    if dp is not None:
+        # the out-side was recorded per disk bucket during the CSR
+        # drain (disjoint owner shards); one collective folds both
+        # sides global, then the audit must balance exactly
+        dp.set_records_in(records)
+        dp.reduce_distributed(
+            lambda v: _allgather_u64(v, obs, "dist/dataplane"),
+            expect=(("map_out", "local"), ("reduce_out", "disjoint")))
+        dp.resolve_hot_keys(
+            gather_strings(dp.hot_hashes(), dictionary, obs).get)
+        dp.check_pairs()
     if config.output_path:
         with obs.phase("write"):
             names = resolve_strings_for(terms.tolist(), dictionary, obs)
@@ -953,6 +965,15 @@ def _run_distributed_core(config: JobConfig, workload: str, obs: Obs
         registry.set("shuffle/transport", engine.transport)
     P_ = engine.n_proc
     dictionary = HashDictionary()
+    # data-plane audit over the GLOBAL shard partition: every process
+    # digests the rows it maps; the in-side vectors allgather-reduce at
+    # finalize so conservation is proven per hash partition end to end
+    from map_oxidize_tpu.obs import dataplane as _dp
+
+    dp = obs.ensure_dataplane(
+        engine.S,
+        conserves=(not doc_mode and reducer.combine == "sum"
+                   and getattr(mapper, "conserves_counts", True)))
 
     # --- per-process checkpoint substore: chunk ownership is part of the
     # job identity (a resume under a different process count would replay
@@ -1049,6 +1070,11 @@ def _run_distributed_core(config: JobConfig, workload: str, obs: Obs
                 staged_outs.append(out)
                 staged += len(out)
                 records += out.records_in
+                if dp is not None and len(out):
+                    rows = _dp.map_output_rows(out, pairs=doc_mode)
+                    if rows is not None:
+                        (dp.record_pairs_in if doc_mode
+                         else dp.record_fold_in)(*rows)
             have = staged > 0
             t0 = _time.perf_counter()
             # round= is the lockstep sequence tag: every process runs
@@ -1081,6 +1107,17 @@ def _run_distributed_core(config: JobConfig, workload: str, obs: Obs
     elif doc_mode:
         with obs.phase("finalize"):
             keys, docs = engine.finalize()
+        if dp is not None:
+            dp.set_records_in(records)
+            dp.reduce_distributed(
+                lambda v: _allgather_u64(v, obs, "dist/dataplane"))
+            # finalize() gathers the full global pair set on every
+            # process, so the out-side is recorded exactly once here
+            # (post-reduce — the reduction must not touch it again)
+            dp.record_pairs_out(keys, docs)
+            dp.resolve_hot_keys(
+                gather_strings(dp.hot_hashes(), dictionary, obs).get)
+            dp.check_pairs()
         # per-term doc counts from the sorted runs (term segments are
         # disjoint across shards, so run lengths are global df)
         if keys.shape[0]:
@@ -1130,6 +1167,16 @@ def _run_distributed_core(config: JobConfig, workload: str, obs: Obs
         k64 = join_u64(hi[live], lo[live])
         if k64.shape[0] != n:
             raise RuntimeError(f"{k64.shape[0]} live keys vs n_unique {n}")
+        if dp is not None:
+            dp.set_records_in(records)
+            dp.reduce_distributed(
+                lambda v: _allgather_u64(v, obs, "dist/dataplane"))
+            # the fold readback is replicated (global on every process):
+            # recorded post-reduce so it is never re-summed across P
+            dp.record_fold_out(k64, vals[live])
+            dp.resolve_hot_keys(
+                gather_strings(dp.hot_hashes(), dictionary, obs).get)
+            dp.check_fold()
         counts = dict(zip(k64.tolist(), vals[live].tolist()))
         if len(counts) != n:
             # a duplicated live key means an exchange/engine bug split one
@@ -1212,9 +1259,16 @@ def finish_distributed_obs(obs: Obs, config: JobConfig, workload: str
     if obs.heartbeat is not None:
         obs.heartbeat.final_beat()
     P_ = obs.n_processes
+    # the data-plane audit (already reduced to global figures by the
+    # core's allgather) publishes its data/* gauges BEFORE the registry
+    # snapshot below, so every process's metrics document — and process
+    # 0's ledger entry — carries them
+    data_doc = obs.finish_dataplane()
     meta = obs.stamp(config, workload)
     metrics_doc = dict(obs.registry.to_dict(), meta=meta,
                        attrib=attrib_doc)
+    if data_doc is not None:
+        metrics_doc["data"] = data_doc
     if xprof_report is not None:
         # per-process xprof shards merge like everything else: each
         # process's metrics doc carries its own program table
@@ -1299,6 +1353,10 @@ def finish_distributed_obs(obs: Obs, config: JobConfig, workload: str
         comms = obs.registry.comms_table()
         if comms:
             extra["comms"] = comms
+        if data_doc is not None:
+            from map_oxidize_tpu.obs.dataplane import ledger_section
+
+            extra["data"] = ledger_section(data_doc)
         ledger.append(config.ledger_dir, ledger.build_entry(
             config, workload, summary, n_processes=P_, extra=extra))
     return summary, trace

@@ -55,7 +55,10 @@ def exchange_payload_bytes(num_shards: int, bucket_cap: int,
 
 def bucket_of(hi: jnp.ndarray, lo: jnp.ndarray, num_shards: int) -> jnp.ndarray:
     """Owner shard of a 64-bit key.  Mixes both planes (FNV-1a's low bits
-    alone are its weakest) and must match any host-side partitioner."""
+    alone are its weakest) and must match any host-side partitioner —
+    :func:`map_oxidize_tpu.obs.dataplane.partition_of` is the numpy twin
+    the data-plane audit buckets by (a parity test pins the two), so the
+    audit's per-partition rows ARE this exchange's routing histogram."""
     return ((hi ^ lo) % jnp.uint32(num_shards)).astype(jnp.int32)
 
 

@@ -296,6 +296,12 @@ def _run_wordcount_body(config: JobConfig, obs: Obs, mapper: Mapper,
     if getattr(engine, "transport", None):
         # collect engines carry a shuffle transport; fold engines don't
         metrics.set("shuffle/transport", engine.transport)
+    # data-plane audit over the engine's hash partitions (virtual ones
+    # when the engine has no shards): conservation, skew, reduction
+    dp = obs.ensure_dataplane(
+        getattr(engine, "S", 1),
+        conserves=(reducer.combine == "sum"
+                   and getattr(mapper, "conserves_counts", True)))
 
     # hash-only map mode: with the host collect-reduce engine the map needs
     # neither per-chunk combining nor key strings (the one final sort dedups;
@@ -325,6 +331,12 @@ def _run_wordcount_body(config: JobConfig, obs: Obs, mapper: Mapper,
         dictionary.update(out.dictionary)
         records_in += out.records_in
         n_chunks += 1
+        if dp is not None and len(out):
+            from map_oxidize_tpu.obs.dataplane import map_output_rows
+
+            rows = map_output_rows(out, pairs=False)
+            if rows is not None:  # scalar fold rows only (not k-means)
+                dp.record_fold_in(*rows)
         if mapper.keys_have_dictionary:
             # the dictionary covers every key fed so far, so its size bounds
             # distinct keys — growth needs no device sync.  upper_bound
@@ -418,11 +430,21 @@ def _run_wordcount_body(config: JobConfig, obs: Obs, mapper: Mapper,
         counts = _readback(engine, dictionary)
         top = counts.top_k(config.top_k)
 
-    # conservation check: every token mapped lands in exactly one count
-    # (Σ counts == Σ records_in); the reference has no such invariant check.
-    # Only meaningful for count-shaped sum workloads — a min/max monoid or a
-    # sum of measurements has no such identity.
-    if reducer.combine == "sum" and getattr(mapper, "conserves_counts", True):
+    # conservation audit: every token mapped lands in exactly one count,
+    # PER HASH PARTITION, with matching order-independent checksums (the
+    # reference has no such invariant check; the audit generalizes the
+    # old global Σ counts == Σ records_in assertion).  Only meaningful
+    # for count-shaped sum workloads — a min/max monoid or a sum of
+    # measurements has no such identity (conserves=False skips it).
+    if dp is not None:
+        dp.set_records_in(records_in)
+        dp.record_fold_out(counts._k64, counts._vals)
+        dp.resolve_hot_keys(dictionary.lookup)
+        dp.check_fold()
+        dp.check_total(counts.total())
+    elif (reducer.combine == "sum"
+          and getattr(mapper, "conserves_counts", True)):
+        # legacy global check — the audit's fallback when disabled
         total = counts.total()
         if records_in and total != records_in:
             raise RuntimeError(
@@ -515,6 +537,9 @@ def _run_inverted_index_body(config: JobConfig, obs: Obs
     engine.obs = obs
     # the active shuffle transport rides /status and the ledger entry
     metrics.set("shuffle/transport", engine.transport)
+    # data-plane audit: (term, doc) pairs must cross the collect shuffle
+    # (and any spill round-trip) as an unchanged multiset
+    dp = obs.ensure_dataplane(getattr(engine, "S", 1))
     dictionary = HashDictionary()
     records_in = 0
     n_chunks = 0
@@ -524,6 +549,10 @@ def _run_inverted_index_body(config: JobConfig, obs: Obs
         dictionary.update(out.dictionary)
         records_in += out.records_in
         n_chunks += 1
+        if dp is not None and len(out):
+            from map_oxidize_tpu.obs.dataplane import map_output_rows
+
+            dp.record_pairs_in(*map_output_rows(out, pairs=True))
         t0 = time.perf_counter()
         with obs.feed_span(rows=len(out)):
             engine.feed(out)
@@ -602,10 +631,21 @@ def _run_inverted_index_body(config: JobConfig, obs: Obs
                 csr = engine.finalize_csr(uniq)
             if csr is not None:
                 postings = Postings(*csr, dictionary)
+                if dp is not None:
+                    # expand the CSR back to per-pair keys: grouping must
+                    # not have dropped or invented a single (term, doc)
+                    dp.record_pairs_out(
+                        np.repeat(csr[0], np.diff(csr[1])), csr[2])
             else:
                 keys, docs = engine.finalize()
                 postings = postings_from_sorted(keys, docs, dictionary)
+                if dp is not None:
+                    dp.record_pairs_out(keys, docs)
             metrics.set("grouped_finalize", csr is not None)
+    if dp is not None:
+        dp.set_records_in(records_in)
+        dp.resolve_hot_keys(dictionary.lookup)
+        dp.check_pairs()
 
     return _finish_inverted_index(config, obs, postings, ckpt,
                                   records_in, n_chunks)

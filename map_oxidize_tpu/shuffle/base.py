@@ -24,6 +24,12 @@ ledger gate and BENCH_DETAIL compare spill behavior across engines):
   single-controller and distributed paths (:func:`record_demotion`).
 * ``shuffle/transport`` gauge — the transport actually driving the job
   (drivers set it; ``/status`` surfaces it live).
+* data-plane audit hooks — a spilling stage digests every pair it
+  stages and drains (order-independent multiset checksums) and raises
+  :class:`~map_oxidize_tpu.obs.dataplane.ConservationError` if a FULL
+  drain returns a different multiset; with a live ``obs.dataplane`` it
+  also records drained pairs into the run's conservation/skew audit
+  and publishes ``data/spill_bucket_imbalance``.
 
 Drain-order invariant (inherited from :mod:`map_oxidize_tpu.runtime.spill`):
 buckets are top-bit key RANGES, so a bucket-by-bucket drain concatenates

@@ -79,6 +79,17 @@ class DiskPairStage:
         self.rows = 0
         self.bytes = 0
         self._buckets_opened: set[int] = set()
+        # spill round-trip conservation: (rows, xor, sum) pair digests
+        # of everything staged vs everything drained — the full-drain
+        # paths compare them and raise ConservationError on mismatch
+        # (obs.dataplane_enabled=False switches the digesting off)
+        self._dig_in = [0, 0, 0]
+        self._dig_out = [0, 0, 0]
+        self._bucket_rows = np.zeros(1 << self.bits, np.int64)
+
+    def _audit_on(self) -> bool:
+        return (self.obs is None
+                or getattr(self.obs, "dataplane_enabled", True))
 
     @property
     def n_buckets(self) -> int:
@@ -106,6 +117,14 @@ class DiskPairStage:
         self._count_io_ms(t0)
         self.rows += n
         self.bytes += int(rec.nbytes)
+        self._bucket_rows += counts
+        if self._audit_on():
+            from map_oxidize_tpu.obs.dataplane import pair_digest
+
+            x, s = pair_digest(keys, docs)
+            self._dig_in[0] += n
+            self._dig_in[1] ^= x
+            self._dig_in[2] = (self._dig_in[2] + s) & 0xFFFFFFFFFFFFFFFF
         record_spill(self.obs, self._buckets_opened, counts, n,
                      int(rec.nbytes))
 
@@ -121,9 +140,55 @@ class DiskPairStage:
         """Drain bucket ``i`` (read + unlink); None if never written."""
         t0 = time.perf_counter()
         try:
-            return self.files.take("kd", i, self.REC)
+            rec = self.files.take("kd", i, self.REC)
         finally:
             self._count_io_ms(t0)
+        if rec is not None and self._audit_on():
+            from map_oxidize_tpu.obs.dataplane import pair_digest
+
+            x, s = pair_digest(rec["k"], rec["d"])
+            self._dig_out[0] += int(rec.shape[0])
+            self._dig_out[1] ^= x
+            self._dig_out[2] = (self._dig_out[2] + s) & 0xFFFFFFFFFFFFFFFF
+        return rec
+
+    def check_roundtrip(self) -> None:
+        """Spill conservation: after a FULL drain, the drained pair
+        multiset must digest identically to what was staged.  A mismatch
+        means the disk round-trip dropped, duplicated, or corrupted
+        records — a named hard failure (:class:`ConservationError`),
+        recorded on the run's data-plane audit when one is live."""
+        if not self._audit_on():
+            return
+        dp = (getattr(self.obs, "dataplane", None)
+              if self.obs is not None else None)
+        if dp is not None:
+            dp.checks += 1
+        if self._dig_in == self._dig_out:
+            return
+        from map_oxidize_tpu.obs.dataplane import ConservationError
+
+        msg = (f"spill conservation violated: staged {self._dig_in[0]} "
+               f"pair rows (xor {self._dig_in[1]:#018x}, sum "
+               f"{self._dig_in[2]:#018x}) but drained {self._dig_out[0]} "
+               f"(xor {self._dig_out[1]:#018x}, sum "
+               f"{self._dig_out[2]:#018x}) — the disk round-trip lost or "
+               f"corrupted records")
+        if dp is not None:
+            dp.violations.append(msg)
+        raise ConservationError(msg)
+
+    def _publish_bucket_skew(self) -> None:
+        """Post-drain disk-bucket skew: max/mean rows over the non-empty
+        top-bit buckets (``data/spill_bucket_imbalance``) — the
+        disk-spill twin of the audit's hash-partition imbalance."""
+        if self.obs is None:
+            return
+        live = self._bucket_rows[self._bucket_rows > 0]
+        if live.shape[0]:
+            self.obs.registry.set(
+                "data/spill_bucket_imbalance",
+                round(float(live.max() / live.mean()), 4))
 
     def drain_csr(self, sort_pairs):
         """Bucket-by-bucket CSR finalize — THE shared drain (the
@@ -144,6 +209,8 @@ class DiskPairStage:
         df_parts: list = []
         doc_path = os.path.join(self.path, "docs.i64")
         peak = 0
+        dp = (getattr(self.obs, "dataplane", None)
+              if self.obs is not None else None)
         with open(doc_path, "wb") as out:
             for i in range(self.n_buckets):
                 rec = self.take(i)
@@ -154,6 +221,10 @@ class DiskPairStage:
                 del rec
                 peak = max(peak, int(keys.shape[0]))
                 keys, docs = sort_pairs(keys, docs)
+                if dp is not None:
+                    # buckets are disjoint key ranges, so per-bucket
+                    # records sum to the exact out-side audit
+                    dp.record_pairs_out(keys, docs)
                 bounds = (np.flatnonzero(np.concatenate(
                     [[True], keys[1:] != keys[:-1]])) if keys.shape[0]
                     else np.empty(0, np.int64))
@@ -162,6 +233,8 @@ class DiskPairStage:
                 t0 = time.perf_counter()
                 out.write(docs.tobytes())
                 self._count_io_ms(t0)
+        self.check_roundtrip()
+        self._publish_bucket_skew()
         holder = self.release()  # caller keeps the doc file alive
         if not terms_parts:
             return (np.empty(0, np.uint64), np.zeros(1, np.int64),
@@ -191,6 +264,10 @@ class DiskPairStage:
                 docs = np.ascontiguousarray(rec["d"])
                 del rec
                 yield sort_pairs(keys, docs)
+            # only a COMPLETED drain proves conservation (an abandoned
+            # generator legitimately leaves staged rows behind)
+            self.check_roundtrip()
+            self._publish_bucket_skew()
         finally:
             self.cleanup()
 
