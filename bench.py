@@ -612,7 +612,8 @@ def _metrics_snapshot(result) -> dict:
                              "feed_block_ms/", "compile/", "xprof/",
                              "device/", "hbm/", "comms/", "heartbeat/",
                              "dispatch/", "alerts/", "attrib/",
-                             "profile/", "calib/", "critpath/"))}
+                             "profile/", "calib/", "critpath/",
+                             "plan/"))}
     return snap
 
 

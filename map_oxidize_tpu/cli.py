@@ -93,6 +93,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "times, capped by the HBM budget; the chosen B is "
                         "recorded in metrics and the run ledger. Outputs "
                         "are identical at any B")
+    p.add_argument("--plan", choices=["auto", "off"], default="auto",
+                   help="job planner: auto (default) solves the tunable "
+                        "knobs (dispatch batch, pipeline depth, chunk "
+                        "size, shuffle transport, sort sample) from the "
+                        "calibration store's measured curves before the "
+                        "run and records the plan — per-knob provenance "
+                        "(curve/memo/default/pinned) plus a predicted "
+                        "wall scored against the measured wall "
+                        "(plan/model_error_pct, gated by obs diff). "
+                        "Explicit knob flags stay authoritative and are "
+                        "recorded as pinned. off skips planning")
     p.add_argument("--key-capacity", type=int, default=1 << 22,
                    help="max distinct keys on device")
     p.add_argument("--backend", choices=["auto", "cpu", "tpu"], default="auto")
@@ -320,6 +331,7 @@ def config_from_args(args: argparse.Namespace) -> JobConfig:
         sort_sample=args.sort_sample,
         collect_max_rows=args.collect_max_rows,
         shuffle_transport=args.shuffle_transport,
+        plan=args.plan,
         hll_precision=args.hll_precision,
         kmeans_k=args.kmeans_k,
         kmeans_iters=args.kmeans_iters,

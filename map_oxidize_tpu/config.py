@@ -265,8 +265,20 @@ class JobConfig:
     #: the old at-cap abort is gone); the fold engines bound DISTINCT
     #: keys, not staged rows, and are unaffected.
     shuffle_transport: str = "auto"
+    #: job planner (runtime/planner.py + obs/plan.py): 'auto' solves the
+    #: tunable knobs from the calibration store's measured curves before
+    #: the run and emits the plan document — per-knob value + provenance
+    #: (curve/memo/default/pinned) + the predicted wall scored against
+    #: the measured wall at finish (``plan/model_error_pct``, a gated
+    #: gauge).  Explicit per-knob overrides are honored verbatim and
+    #: recorded as ``pinned``.  'off' skips planning entirely (no plan
+    #: doc, no ``plan/*`` gauges beyond the dispatch aliases)
+    plan: str = "auto"
 
     def validate(self) -> "JobConfig":
+        if self.plan not in ("auto", "off"):
+            raise ValueError(
+                f"plan must be auto|off, got {self.plan!r}")
         if self.tokenizer not in ("ascii", "unicode"):
             raise ValueError(f"tokenizer must be ascii|unicode, got {self.tokenizer!r}")
         if self.backend not in ("auto", "cpu", "tpu"):

@@ -173,6 +173,12 @@ class Obs:
     #: ``--calib-dir`` — or when the store on disk refused to load
     calib: "object | None" = None
     calib_prior: "object | None" = None
+    #: the job plan (runtime/planner.py + obs/plan.py): solved in
+    #: ``recording`` before the body runs (knob choices + provenance +
+    #: predicted wall), scored against the measured attribution in
+    #: ``finish`` (``plan/model_error_pct``).  None with ``--plan off``
+    #: or outside a workload body (the resident server's own bundle)
+    plan: "dict | None" = None
     #: data-plane observatory (obs/dataplane.py): the per-partition
     #: row-conservation/skew audit the engines feed, created lazily by
     #: the driver through :meth:`ensure_dataplane` (the partition count
@@ -341,6 +347,20 @@ class Obs:
         self.dataplane.publish(self.registry)
         return self.dataplane.doc()
 
+    def knob(self, name: str, fallback):
+        """The planner-effective value of a tunable knob: the plan's
+        chosen value when a plan exists, else the caller's config value.
+        Drivers consult this instead of the raw config so a curve-driven
+        choice applies WITHOUT mutating the config (the ledger's
+        config-hash identity must not depend on what the planner
+        chose)."""
+        p = self.plan
+        if p:
+            row = (p.get("knobs") or {}).get(name)
+            if row is not None and row.get("value") is not None:
+                return row["value"]
+        return fallback
+
     def request_cancel(self, reason: str = "cancelled") -> None:
         """Ask the job to stop at its next cancellation point (phase
         boundary or per-block feed).  Thread-safe; the first reason
@@ -452,12 +472,17 @@ class Obs:
             self.registry.set(k, v)
         return report
 
-    def _merge_calibration(self, xprof_report: dict | None) -> None:
-        """Fold this run's comms table + xprof program rows into the
-        persistent calibration store and merge it atomically into the
-        store file (obs/calib.py).  A refusal (schema/identity mismatch
-        on disk) records ``calib/merge_refused`` and moves on — the
-        job's own result is never hostage to the store."""
+    def _merge_calibration(self, xprof_report: dict | None,
+                           workload: str | None = None,
+                           corpus_bytes: float = 0.0,
+                           attrib_doc: dict | None = None) -> None:
+        """Fold this run's comms table + xprof program rows — plus the
+        per-workload wall-attribution curve row the planner's wall
+        prediction reads (obs/calib.py ``workloads`` section) — into
+        the persistent calibration store and merge it atomically into
+        the store file.  A refusal (schema/identity mismatch on disk)
+        records ``calib/merge_refused`` and moves on — the job's own
+        result is never hostage to the store."""
         if self.calib is None:
             return
         from map_oxidize_tpu.obs import calib as _calib
@@ -467,6 +492,9 @@ class Obs:
             ident = _calib.run_identity(self.n_processes)
             touched = self.calib.accumulate_run(
                 ident, self.registry.comms_table(), xprof_report)
+            if workload and workload != "serve":
+                touched += self.calib.accumulate_workload(
+                    ident, workload, corpus_bytes, attrib_doc)
             if touched:
                 self.calib.save_merged()
                 self.registry.set("calib/rows_merged", touched)
@@ -515,7 +543,25 @@ class Obs:
                 _critpath.publish(self.registry, critpath_doc)
             except ValueError:
                 pass
-        self._merge_calibration(xprof_report)
+        # score the plan against the measured attribution (predicted
+        # vs actual wall per bucket; plan/model_error_pct when the plan
+        # actually predicted) BEFORE the summary below, so the ledger
+        # entry and the gate carry the error gauge
+        if self.plan is not None:
+            from map_oxidize_tpu.obs import plan as _plan
+
+            try:
+                _plan.finalize(self, self.plan, attrib_doc)
+            except Exception:  # pragma: no cover - scoring is evidence,
+                pass           # never a reason to fail a finished job
+        corpus_bytes = 0.0
+        try:
+            corpus_bytes = float(os.path.getsize(config.input_path))
+        except (OSError, TypeError, AttributeError):
+            pass
+        self._merge_calibration(xprof_report, workload=workload,
+                                corpus_bytes=corpus_bytes,
+                                attrib_doc=attrib_doc)
         # the data-plane audit lands before the summary below, so the
         # ledger entry (and obs diff --gate) carries the data/* gauges
         data_doc = self.finish_dataplane()
@@ -527,6 +573,8 @@ class Obs:
         if config.metrics_out:
             doc = dict(self.registry.to_dict(), meta=meta)
             doc["attrib"] = attrib_doc
+            if self.plan is not None:
+                doc["plan"] = self.plan
             if critpath_doc is not None:
                 doc["critpath"] = critpath_doc
             if data_doc is not None:
@@ -552,6 +600,12 @@ class Obs:
             from map_oxidize_tpu.obs import ledger
 
             extra: dict = {}
+            if self.plan is not None:
+                # the full plan doc rides the entry (knobs + provenance
+                # + predicted wall per bucket) — `obs plan` renders it
+                # straight from ledger history, and the flat plan/*
+                # gauges are already in the summary the gate compares
+                extra["plan"] = self.plan
             comms = self.registry.comms_table()
             if comms:
                 extra["comms"] = comms
@@ -586,6 +640,26 @@ class Obs:
         from map_oxidize_tpu.obs.context import use_obs
 
         self.workload = workload
+        if (self.plan is None and workload and workload != "serve"
+                and getattr(config, "plan", "auto") != "off"):
+            # the job plan: solve the knobs + predict the wall BEFORE
+            # the body runs, from the calibration store's curves; the
+            # plan/* gauges land now so /status and the time series
+            # carry the plan while the job runs (obs/plan.py scores it
+            # at finish).  Planning is evidence — never a reason to
+            # fail the job it describes.
+            from map_oxidize_tpu.obs import plan as _plan
+            from map_oxidize_tpu.runtime import planner as _planner
+
+            try:
+                self.plan = _planner.build_plan(
+                    config, workload, calib_prior=self.calib_prior,
+                    n_processes=self.n_processes)
+                _plan.publish(self.registry, self.plan)
+            except Exception as e:
+                from map_oxidize_tpu.utils.logging import get_logger
+
+                get_logger(__name__).warning("job planning failed: %s", e)
         try:
             with use_obs(self):
                 yield self

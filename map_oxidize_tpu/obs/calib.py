@@ -12,9 +12,13 @@ them across runs:
 * one versioned JSON document (``<calib_dir>/calib.json``,
   ``moxt-calib-v1``) holding **comms rows** keyed
   ``(platform, device-count, topology, collective, program,
-  shape-bucket)`` — calls, payload bytes, sampled latency mass — and
+  shape-bucket)`` — calls, payload bytes, sampled latency mass —
   **program rows** keyed ``(platform, device-count, topology, program)``
-  — dispatches, dispatch wall, sampled device compute, compiles;
+  — dispatches, dispatch wall, sampled device compute, compiles — and
+  **workload rows** keyed ``(platform, device-count, topology,
+  workload)`` — corpus bytes, wall, and per-attribution-bucket wall
+  mass, the shape the job planner's wall prediction is read from
+  (``runtime/planner.py``);
 * shape-bucket is the power-of-two floor of the per-call payload
   (``"1MB"`` covers [1MB, 2MB)): close payloads share a row, so curves
   accumulate density instead of exploding per exact shape;
@@ -29,12 +33,17 @@ them across runs:
   ``calib/merge_refused`` lands as a gauge either way.
 
 ``obs calib`` renders the store as per-collective bandwidth curves —
-the measurement substrate ROADMAP items 2 and 3 consume.
+the measurement substrate ROADMAP items 2 and 3 consume.  The
+**read side** of those curves lives here too: :func:`program_curve`,
+:func:`workload_curve` and :func:`interpolate_latency_ms` turn the
+accumulated mass back into per-call / per-MB rates the planner and the
+collective chooser consume.
 """
 
 from __future__ import annotations
 
 import json
+import math
 import os
 import sys
 import time
@@ -51,6 +60,7 @@ CALIB_FILE = "calib.json"
 _COMM_IDENTITY = ("platform", "device_count", "topology", "collective",
                   "program", "shape_bucket")
 _PROG_IDENTITY = ("platform", "device_count", "topology", "program")
+_WORKLOAD_IDENTITY = ("platform", "device_count", "topology", "workload")
 
 
 class CalibMismatch(ValueError):
@@ -101,6 +111,11 @@ def _comm_key(ident: dict, collective: str, program: str,
 def _prog_key(ident: dict, program: str) -> str:
     return "|".join([ident["platform"], str(ident["device_count"]),
                      ident["topology"], program])
+
+
+def _workload_key(ident: dict, workload: str) -> str:
+    return "|".join([ident["platform"], str(ident["device_count"]),
+                     ident["topology"], workload])
 
 
 class CalibStore:
@@ -194,14 +209,45 @@ class CalibStore:
             self.doc["runs"] = int(self.doc.get("runs") or 0) + 1
         return touched
 
+    def accumulate_workload(self, ident: dict, workload: str,
+                            corpus_bytes: float,
+                            attrib_doc: dict | None) -> int:
+        """Fold one finished run's wall attribution into the per-workload
+        curve row under ``ident`` — the mass :func:`workload_curve`
+        turns back into the planner's per-MB wall prediction.  Bucket
+        fields are flat (``bucket_<name>_ms``) so the generic numeric
+        merge in :meth:`merge_from` accumulates them like any other
+        counter.  Returns rows touched (0/1)."""
+        if not workload or not attrib_doc:
+            return 0
+        wall = float(attrib_doc.get("wall_ms") or 0.0)
+        if wall <= 0 or not corpus_bytes or corpus_bytes <= 0:
+            return 0
+        workloads = self.doc.setdefault("workloads", {})
+        key = _workload_key(ident, workload)
+        row = workloads.get(key)
+        if row is None:
+            row = workloads[key] = dict(
+                ident, workload=workload, runs=0, corpus_bytes=0.0,
+                wall_ms=0.0, unattributed_ms=0.0)
+        row["runs"] += 1
+        row["corpus_bytes"] += float(corpus_bytes)
+        row["wall_ms"] += wall
+        row["unattributed_ms"] += float(
+            attrib_doc.get("unattributed_ms") or 0.0)
+        for name, b in (attrib_doc.get("buckets") or {}).items():
+            f = f"bucket_{name}_ms"
+            row[f] = float(row.get(f, 0.0)) + float(b.get("ms") or 0.0)
+        return 1
+
     # --- merge / persist --------------------------------------------------
 
     def merge_from(self, other: dict) -> None:
         """Fold another store DOCUMENT into this one (validated first)."""
         validate_doc(other)
-        for section in ("comms", "programs"):
+        for section in ("comms", "programs", "workloads"):
             for key, row in (other.get(section) or {}).items():
-                mine = self.doc[section].get(key)
+                mine = self.doc.setdefault(section, {}).get(key)
                 if mine is None:
                     self.doc[section][key] = dict(row)
                     continue
@@ -314,7 +360,8 @@ def validate_doc(doc: dict, path: str = "") -> None:
             f"calibration store version {doc.get('version')!r} != "
             f"supported {CALIB_VERSION}{where}; refusing to merge")
     for section, ident_fields in (("comms", _COMM_IDENTITY),
-                                  ("programs", _PROG_IDENTITY)):
+                                  ("programs", _PROG_IDENTITY),
+                                  ("workloads", _WORKLOAD_IDENTITY)):
         for key, row in (doc.get(section) or {}).items():
             parts = key.split("|")
             if len(parts) != len(ident_fields):
@@ -327,6 +374,107 @@ def validate_doc(doc: dict, path: str = "") -> None:
                         f"{section} row {key!r}: stored {field}="
                         f"{stored!r} disagrees with its key{where}; "
                         "refusing to merge a torn/doctored store")
+
+
+# --- read-side curve APIs (the planner's substrate) ------------------------
+
+
+def program_curve(store: "CalibStore | None", ident: dict,
+                  program: str) -> dict | None:
+    """The store's warm per-call figures for one program under this
+    identity: ``dispatch_ms_per_call`` (the launch floor) and
+    ``compute_ms_per_sample`` — the cross-process form of the compile
+    ledger's in-memory measurements, what a COLD process plans auto-B
+    from.  None when the store has no usable row."""
+    if store is None:
+        return None
+    row = (store.doc.get("programs") or {}).get(_prog_key(ident, program))
+    if not row:
+        return None
+    out: dict = {"runs": int(row.get("runs") or 0)}
+    n = row.get("dispatches") or 0
+    if n and row.get("dispatch_ms"):
+        out["dispatch_ms_per_call"] = float(row["dispatch_ms"]) / n
+    s = row.get("compute_samples") or 0
+    if s and row.get("compute_ms"):
+        out["compute_ms_per_sample"] = float(row["compute_ms"]) / s
+    return out if len(out) > 1 else None
+
+
+def workload_curve(store: "CalibStore | None", ident: dict,
+                   workload: str) -> dict | None:
+    """The store's per-MB wall rates for one workload under this
+    identity: ``wall_ms_per_mb`` plus ``buckets_ms_per_mb`` in the SAME
+    bucket names ``obs where`` attributes — the planner multiplies them
+    by the new corpus's size for its predicted wall.  None when the
+    store has no row with positive bytes and wall."""
+    if store is None:
+        return None
+    row = (store.doc.get("workloads") or {}).get(
+        _workload_key(ident, workload))
+    if not row:
+        return None
+    mb = float(row.get("corpus_bytes") or 0.0) / (1 << 20)
+    wall = float(row.get("wall_ms") or 0.0)
+    if mb <= 0 or wall <= 0:
+        return None
+    runs = int(row.get("runs") or 1)
+    curve = {
+        "runs": runs,
+        "wall_ms_per_mb": wall / mb,
+        "mean_corpus_bytes": float(row["corpus_bytes"]) / max(runs, 1),
+        "buckets_ms_per_mb": {},
+    }
+    for f, v in row.items():
+        if f.startswith("bucket_") and f.endswith("_ms"):
+            curve["buckets_ms_per_mb"][f[len("bucket_"):-len("_ms")]] = (
+                float(v) / mb)
+    return curve
+
+
+def interpolate_latency_ms(store: "CalibStore | None", ident: dict,
+                           collective: str, nbytes: float,
+                           program: str | None = None) -> float | None:
+    """Read-side interpolation over the per-shape-bucket latency curve:
+    the expected one-call latency of ``collective`` at payload
+    ``nbytes`` under this identity, log-linear in payload between the
+    measured bucket means and clamped at the curve's ends (collective
+    cost is near-affine in log-payload across the bucket range — the
+    portable-collectives premise).  ``program=None`` pools rows across
+    programs.  None when no sampled row matches."""
+    if store is None:
+        return None
+    pts = []
+    for row in (store.doc.get("comms") or {}).values():
+        if (row.get("platform") != ident["platform"]
+                or str(row.get("device_count")) != str(
+                    ident["device_count"])
+                or row.get("topology") != ident["topology"]
+                or row.get("collective") != collective):
+            continue
+        if program is not None and row.get("program") != program:
+            continue
+        calls = row.get("calls") or 0
+        samples = row.get("latency_samples") or 0
+        if calls and samples and row.get("latency_ms"):
+            pts.append((float(row["bytes"]) / calls,
+                        float(row["latency_ms"]) / samples))
+    if not pts:
+        return None
+    pts.sort()
+    x = max(float(nbytes), 1.0)
+    if x <= pts[0][0]:
+        return pts[0][1]
+    if x >= pts[-1][0]:
+        return pts[-1][1]
+    for (x0, y0), (x1, y1) in zip(pts, pts[1:]):
+        if x0 <= x <= x1:
+            if x1 <= x0:
+                return y1
+            t = ((math.log(x) - math.log(x0))
+                 / (math.log(x1) - math.log(x0)))
+            return y0 + t * (y1 - y0)
+    return pts[-1][1]  # pragma: no cover - unreachable past the clamp
 
 
 # --- rendering (the `obs calib` table) -------------------------------------

@@ -1,6 +1,6 @@
 """``python -m map_oxidize_tpu obs ...`` — observability artifact tools.
 
-Eleven subcommands, all pure host-side work (no jax, no backend init):
+Twelve subcommands, all pure host-side work (no jax, no backend init):
 
 * ``obs merge`` — combine a distributed run's per-process trace shards
   (``<trace_out>.proc<i>``) into one Chrome trace (pid = process slot)
@@ -41,6 +41,13 @@ Eleven subcommands, all pure host-side work (no jax, no backend init):
   change detection against the median of prior entries, and a ranked
   movers report — when a gate trips, the table that says WHICH counter
   moved and when (``--json`` for the structured form).
+* ``obs plan`` — the plan observatory report
+  (:mod:`map_oxidize_tpu.obs.plan`): the knob values the planner chose
+  before the job ran, each with its evidence provenance
+  (curve/memo/default/pinned), and — when the calibration store held a
+  workload curve — the predicted wall decomposition next to what
+  actually happened, bucket by bucket, with the headline
+  ``plan/model_error_pct``.
 * ``obs where`` — the wall-clock attribution report
   (:mod:`map_oxidize_tpu.obs.attrib`): where every millisecond of a
   job's wall went — named buckets plus the unattributed remainder —
@@ -244,6 +251,23 @@ def build_obs_parser() -> argparse.ArgumentParser:
     w.add_argument("--json", action="store_true",
                    help="emit the structured attribution document")
 
+    pl = sub.add_parser(
+        "plan", help="render the plan observatory: the knob values the "
+                     "planner chose before the job ran (with per-knob "
+                     "provenance — curve/memo/default/pinned) and the "
+                     "predicted-vs-actual wall decomposition, from a "
+                     "--metrics-out document, an obs shard, or a crash "
+                     "bundle")
+    pl.add_argument("metrics", help="a run's --metrics-out JSON, a "
+                                    "<metrics_out>.proc<i> shard document, "
+                                    "or a flight-recorder --crash-dir "
+                                    "bundle directory (its metrics.json "
+                                    "is used; a crash-dir root resolves "
+                                    "to the newest bundle)")
+    pl.add_argument("--json", action="store_true",
+                    help="emit the structured plan document instead of "
+                         "the rendered tables")
+
     fl = sub.add_parser(
         "flame", help="render a deep-profile capture's host sampling "
                       "stacks (collapsed-stack format): hottest stacks "
@@ -353,6 +377,8 @@ def obs_main(argv: list[str]) -> int:
         return _trend(args)
     if args.cmd == "where":
         return _where(args)
+    if args.cmd == "plan":
+        return _plan(args)
     if args.cmd == "flame":
         return _flame(args)
     if args.cmd == "calib":
@@ -735,6 +761,35 @@ def _data(args) -> int:
         print("error: no data section in this metrics document (produced "
               "by a pre-audit version, or the run disabled it with "
               "--no-data-audit)", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(section, indent=1, sort_keys=True))
+        return 0
+    print(render(section))
+    return 0
+
+
+def _plan(args) -> int:
+    import json
+
+    from map_oxidize_tpu.obs.plan import render
+
+    path = resolve_metrics_path(args.metrics)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"error: cannot read metrics document {path!r}: {e}",
+              file=sys.stderr)
+        return 2
+    if doc.get("schema"):  # an obs shard: the metrics doc nests inside
+        doc = doc.get("metrics", {})
+    section = doc.get("plan")
+    if not section:
+        print("error: no plan section in this metrics document (produced "
+              "by a pre-planner version, the job ran with --plan off, or "
+              "this is a resident server's own bundle — each job plans "
+              "itself)", file=sys.stderr)
         return 2
     if args.json:
         print(json.dumps(section, indent=1, sort_keys=True))

@@ -731,6 +731,13 @@ def headline(doc: dict) -> dict:
         "critpath/path_over_wall_pct": doc.get("path_over_wall_pct", 0.0),
         "critpath/bound_by": doc.get("bound_by", "?"),
     }
+    if isinstance(doc.get("model_error_pct"), (int, float)):
+        # the what-if replay's fidelity number, ledger-visible so
+        # replay-model error and the planner's plan/model_error_pct
+        # trend side by side (obs trend ranks both up-is-bad).  The
+        # degenerate single-process doc never replays, so it carries
+        # no error to publish
+        out["critpath/model_error_pct"] = doc["model_error_pct"]
     if doc.get("n_processes", 1) > 1:
         # the process-blame share only exists where processes exist —
         # the degenerate single-chip form must NOT publish either gauge

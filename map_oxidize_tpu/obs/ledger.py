@@ -368,6 +368,26 @@ def diff_entries(a: dict, b: dict, threshold_pct: float = 10.0,
                 regressions.append(
                     f"{name}: {va:.2f} -> {vb:.2f} max/mean partition "
                     "rows (key-skew regression)")
+        elif name == "plan/model_error_pct":
+            # plan observatory gate: the planner's predicted wall
+            # diverging from the measured wall by this many MORE
+            # percentage points than the previous comparable run means
+            # the performance model drifted (stale or doctored
+            # calibration curves, an unmodeled cost change).  Points,
+            # not relative percent (8% -> 20% is model noise on short
+            # runs; 8% -> 300% is a broken model); a missing baseline
+            # (a cold run that recorded no prediction) is unknown,
+            # not 0
+            from map_oxidize_tpu.obs.plan import PLAN_ERROR_GATE_POINTS
+
+            if va != vb:
+                rows.append((name, va, vb, pct))
+            if (isinstance(va, (int, float))
+                    and isinstance(vb, (int, float))
+                    and vb - va > PLAN_ERROR_GATE_POINTS):
+                regressions.append(
+                    f"{name}: {va:.1f}% -> {vb:.1f}% predicted-vs-"
+                    "actual wall error (plan model drift)")
         elif name == "heartbeat/stalls":
             # stall episodes are evidence of a wedged feed loop or a
             # straggler-gated collective; ANY increase flags

@@ -327,6 +327,12 @@ def build_status(obs, config, workload: str | None = None) -> dict:
           if k.startswith("critpath/")}
     if cp:
         doc["critpath"] = cp
+    # the plan observatory document: what the planner promised before
+    # the job ran (knobs + provenance + predicted wall) and — once the
+    # job finishes — what actually happened.  /status snapshots of a
+    # running job show the promise; archived ones show the verdict
+    if getattr(obs, "plan", None):
+        doc["plan"] = obs.plan
     # the data-plane headline (conservation, skew, reduction): either
     # the live audit mid-run, or the published data/* gauges post-finish
     dp = getattr(obs, "dataplane", None)

@@ -261,6 +261,24 @@ DEFAULT_RULES: tuple[dict, ...] = (
                     "concentrating the shuffle on few partitions (see "
                     "obs data for the heatmap; corroborate with the "
                     "critpath straggler save fraction)"},
+    # plan observatory drift: a resident server re-plans every
+    # submission from its own calibration history, and the scheduler
+    # publishes the MEDIAN prediction error of its recently finished
+    # jobs onto the server registry (median-of-recent so one noisy
+    # micro-job cannot trip it; a cold server publishes nothing and
+    # stays silent by construction, like a cold CLI run's
+    # platform_default provenance).  Sustained error above 150% means
+    # the stored curves no longer describe the machine (stale store
+    # after a topology/attach change, doctored evidence) — recalibrate
+    # or clear the store.  The one-shot form of the same signal is the
+    # plan/model_error_pct ledger gate (obs diff --gate).
+    {"name": "plan-model-drift", "metric": "plan/model_error_pct",
+     "kind": "value", "op": ">", "threshold": 150, "for_s": 5,
+     "scope": "serve", "severity": "warning",
+     "evidence": "plan/predicted_wall_ms",
+     "description": "resident server's plan predictions went stale — "
+                    "median predicted-vs-actual wall error above 150% "
+                    "(see obs plan; recalibrate or clear the store)"},
 )
 
 

@@ -87,6 +87,10 @@ def _direction(name: str, pct: float | None) -> str:
         return "new"
     up_bad = (name.startswith(("phase/", "compile/", "alerts/"))
               or name.endswith(("_s", "_ms", "/p50", "/p95", "/max"))
+              # model-fidelity gauges (plan/model_error_pct, critpath/
+              # model_error_pct): prediction error growing is the
+              # planner's or replayer's model going stale
+              or name.endswith("model_error_pct")
               or "stall" in name or "spill" in name
               or name in ("rc", "unattributed_pct",
                           "attrib/unattributed_pct"))

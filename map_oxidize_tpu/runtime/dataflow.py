@@ -167,8 +167,10 @@ def _feed_records(config: JobConfig, obs: Obs, engine, corpora) -> tuple:
                 yield out, base + end * 16
             base += end * 16
 
-    for out, next_off in pipelined(_gen(), config.pipeline_depth, obs,
-                                   name="map"):
+    for out, next_off in pipelined(_gen(),
+                                   obs.knob("pipeline_depth",
+                                            config.pipeline_depth),
+                                   obs, name="map"):
         records += out.records_in
         n_chunks += 1
         t0 = time.perf_counter()

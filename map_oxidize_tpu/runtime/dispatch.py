@@ -16,18 +16,17 @@ k-means driver, the fold engine, bench) resolves it the same way:
 * an explicit ``--dispatch-batch N`` wins verbatim (capped at the chunk
   count — padding a block mostly with dead chunks would only waste
   transfer and compile a needlessly large shape);
-* ``auto`` solves the overlap roofline from measured inputs.  With
-  double-buffered staging, steady-state wall per chunk is
-  ``max(produce_ms, floor_ms / B + compute_ms)`` — the host produce of
-  block i+1 hides behind block i's launch+compute.  The smallest B that
-  makes the device side sink under the host side is
-  ``ceil(floor / (produce - compute))``; when the host is not the
-  bottleneck (or produce is unknown) B amortizes the floor against
-  compute alone, ``ceil(floor / compute)``.  Inputs, in preference
-  order: the compile ledger's measured per-dispatch gap and sampled
-  device-compute (warm processes — the resident server's case), the
-  xprof roofline estimate (cost-analysis FLOPs over the session peak)
-  when cold, and platform defaults last;
+* ``auto`` solves the overlap roofline from measured inputs (the
+  solver itself — ``max(produce_ms, floor_ms / B + compute_ms)``, the
+  smallest B that sinks the device side under the host side — lives in
+  :func:`map_oxidize_tpu.runtime.planner.solve_batch`, shared with the
+  job planner's pre-solve).  Inputs, in preference order: the compile
+  ledger's measured per-dispatch gap and sampled device-compute (warm
+  processes — the resident server's case), the calibration store's
+  cross-run program curve (``--calib-dir``: a COLD process planning
+  from the last run's measurements), the xprof roofline estimate
+  (cost-analysis FLOPs over the session peak), and platform defaults
+  last;
 * the result is capped by the **HBM admission estimate**: two staged
   blocks are in flight at once (double buffering), so B may not exceed
   ``budget / (4 * chunk_bytes)`` against the probed device budget.
@@ -38,15 +37,17 @@ flip B between jobs (a flipped B is a fresh program variant — exactly
 the recompile the zero-delta gate exists to catch).
 
 The chosen B and every input that produced it are recorded as
-``dispatch/*`` gauges, so they ride ``JobResult.metrics``, the metrics
-document, and the run-ledger entry.  ``dispatch_batch`` is deliberately
-NOT ledger/checkpoint identity: outputs are bit-identical at any B, so
-runs gate and resume across B.
+``dispatch/*`` gauges — mirrored under the planner's unified
+``plan/dispatch_*`` namespace (obs/plan.py) with the ``dispatch/*``
+spellings kept as back-compat aliases, so ``obs diff``/``obs trend``
+trajectories stay continuous — and ride ``JobResult.metrics``, the
+metrics document, and the run-ledger entry.  ``dispatch_batch`` is
+deliberately NOT ledger/checkpoint identity: outputs are bit-identical
+at any B, so runs gate and resume across B.
 """
 
 from __future__ import annotations
 
-import math
 import os
 import sys
 import threading
@@ -144,6 +145,39 @@ def measured_compute_ms_per_chunk(program: str) -> float | None:
     return per_dispatch / max(cpd, 1.0)
 
 
+def _calib_curve(program: str) -> dict | None:
+    """The calibration store's warm per-call figures for ``program``
+    under the current job's identity — read through the context-bound
+    ``Obs.calib_prior`` (the read-only cross-run history), so a COLD
+    process with ``--calib-dir`` resolves auto-B from the last run's
+    measurements instead of platform defaults.  None without a bound
+    obs, a loaded store, or a usable row."""
+    try:
+        from map_oxidize_tpu.obs.context import current_obs
+
+        obs = current_obs()
+        prior = getattr(obs, "calib_prior", None)
+        if prior is None:
+            return None
+        from map_oxidize_tpu.obs import calib as _calib
+
+        ident = _calib.run_identity(getattr(obs, "n_processes", 1))
+        return _calib.program_curve(prior, ident, program)
+    except Exception:  # pragma: no cover - curve reads are best-effort
+        return None
+
+
+def has_any_cached_auto(program: str) -> bool:
+    """True when SOME auto resolution for this program is memoized,
+    regardless of shape — the planner's ``memo`` provenance probe (at
+    plan time the chunk shape is not known yet, so the exact-key
+    :func:`has_cached_auto` would miss warm entries)."""
+    platform = _platform()
+    with _auto_lock:
+        return any(k[0] == program and k[3] == platform
+                   for k in _auto_cache)
+
+
 def has_cached_auto(program: str, chunk_device_bytes: int = 0,
                     flops_per_chunk: float | None = None) -> bool:
     """True when an auto resolution for this (program, shape, platform)
@@ -206,40 +240,42 @@ def _resolve_auto(program: str, chunk_device_bytes: int,
         floor = measured_dispatch_floor_ms(program)
         if floor is not None:
             info["floor_source"] = "measured"
+    curve = _calib_curve(program) if floor is None else None
+    if floor is None and curve and curve.get("dispatch_ms_per_call"):
+        # the calibration store's cross-run figure: a cold process
+        # planning from the last run's measured floor (the planner's
+        # ``curve`` provenance)
+        floor = curve["dispatch_ms_per_call"]
+        info["floor_source"] = "calib_curve"
     if floor is None:
         floor = TPU_FLOOR_MS if _platform() == "tpu" else DEFAULT_FLOOR_MS
         info["floor_source"] = "platform_default"
     compute = measured_compute_ms_per_chunk(program)
     if compute is not None:
         info["compute_source"] = "measured"
-    elif flops_per_chunk:
-        from map_oxidize_tpu.obs.xprof import device_peaks
+    else:
+        if curve is None:
+            curve = _calib_curve(program)
+        if curve and curve.get("compute_ms_per_sample"):
+            compute = curve["compute_ms_per_sample"]
+            info["compute_source"] = "calib_curve"
+        elif flops_per_chunk:
+            from map_oxidize_tpu.obs.xprof import device_peaks
 
-        peak = device_peaks().get("flops")
-        if peak:
-            compute = flops_per_chunk / peak * 1e3
-            info["compute_source"] = "roofline_estimate"
+            peak = device_peaks().get("flops")
+            if peak:
+                compute = flops_per_chunk / peak * 1e3
+                info["compute_source"] = "roofline_estimate"
     info["floor_ms"] = round(floor, 4)
     if compute is not None:
         info["compute_ms_per_chunk"] = round(compute, 4)
     if produce_ms is not None:
         info["produce_ms_per_chunk"] = round(produce_ms, 4)
 
-    if compute is None and produce_ms is None:
-        b = default_auto
-        info["rule"] = "default_no_measurements"
-    else:
-        comp = compute or 0.0
-        headroom = (produce_ms - comp) if produce_ms is not None else None
-        if headroom is not None and headroom > 0.05:
-            # host-bound once overlapped: the smallest B whose launch
-            # floor sinks under the produce time
-            b = math.ceil(floor / headroom)
-            info["rule"] = "overlap_host_produce"
-        else:
-            b = math.ceil(floor / max(comp, 0.05))
-            info["rule"] = "amortize_vs_compute"
-    b = max(1, min(b, MAX_AUTO_B))
+    from map_oxidize_tpu.runtime.planner import solve_batch
+
+    b, info["rule"] = solve_batch(floor, compute, produce_ms,
+                                  default_auto, MAX_AUTO_B)
 
     budget = hbm_budget_bytes()
     if budget > 0 and chunk_device_bytes > 0:
@@ -260,10 +296,14 @@ def _resolve_auto(program: str, chunk_device_bytes: int,
 def record_dispatch_batch(registry, b: int, info: dict,
                           prefix: str = "dispatch",
                           fresh_probe_ms: float | None = None) -> None:
-    """Export the decision as flat gauges (``dispatch/batch``,
-    ``dispatch/batch_mode``, ``dispatch/<input>`` ...) so it lands in
+    """Export the decision as flat gauges so it lands in
     ``JobResult.metrics``, the metrics document, and the ledger entry —
     the record the ISSUE's "auto resolving to a logged B" gate reads.
+    The primary spellings live under the planner's unified namespace
+    (``plan/<prefix>_batch``, ``plan/<prefix>_batch_mode``,
+    ``plan/<prefix>_<input>`` ...); the historical ``<prefix>/batch``
+    forms are written too as back-compat aliases, so pre-planner ledger
+    trajectories stay continuous under ``obs diff``/``obs trend``.
 
     ``fresh_probe_ms`` is the wall of a produce probe the CALLER just
     paid on the critical path (the auto-B fault-in measurement) — it
@@ -274,11 +314,12 @@ def record_dispatch_batch(registry, b: int, info: dict,
     this run, so only a caller-declared fresh probe counts."""
     if registry is None:
         return
-    registry.set(f"{prefix}/batch", int(b))
-    registry.set(f"{prefix}/batch_mode", info.get("mode", "fixed"))
-    for k, v in info.items():
-        if k in ("mode", "batch") or v is None:
-            continue
-        registry.set(f"{prefix}/{k}", v)
+    for fmt in (f"{prefix}/{{}}", f"plan/{prefix}_{{}}"):
+        registry.set(fmt.format("batch"), int(b))
+        registry.set(fmt.format("batch_mode"), info.get("mode", "fixed"))
+        for k, v in info.items():
+            if k in ("mode", "batch") or v is None:
+                continue
+            registry.set(fmt.format(k), v)
     if fresh_probe_ms is not None and fresh_probe_ms > 0:
         registry.count("attrib/probe_ms", fresh_probe_ms)

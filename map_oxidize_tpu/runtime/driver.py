@@ -408,7 +408,9 @@ def _run_wordcount_body(config: JobConfig, obs: Obs, mapper: Mapper,
     # checkpoint spill and the output are byte-identical to depth 1.
     with obs.phase("map+reduce"):
         if native_file_iter is not None:
-            it = pipelined(native_file_iter, config.pipeline_depth, obs,
+            it = pipelined(native_file_iter,
+                           obs.knob("pipeline_depth",
+                                    config.pipeline_depth), obs,
                            name="map")
             for i, (out, next_off) in enumerate(it):
                 _ingest(out, next_off)
@@ -417,7 +419,8 @@ def _run_wordcount_body(config: JobConfig, obs: Obs, mapper: Mapper,
         else:
             outputs = run_map_phase(
                 chunks, mapper, config.num_map_workers, config.max_retries,
-                pipeline_depth=config.pipeline_depth, obs=obs,
+                pipeline_depth=obs.knob("pipeline_depth",
+                                        config.pipeline_depth), obs=obs,
             )
             for idx, out in outputs:
                 gidx = resume_k + idx
@@ -595,7 +598,9 @@ def _run_inverted_index_body(config: JobConfig, obs: Obs
                     yield mapper.map_docs(chunk, off - len(chunk)), off
             it = _host_iter()
         # prefetch: doc-chunk read+tokenize overlaps the collect feed
-        it = pipelined(it, config.pipeline_depth, obs, name="map")
+        it = pipelined(it, obs.knob("pipeline_depth",
+                                    config.pipeline_depth), obs,
+                       name="map")
         for i, (out, next_off) in enumerate(it):
             _ingest(out, next_off)
             if ckpt is not None:
@@ -941,7 +946,9 @@ def _run_kmeans_body(config: JobConfig, obs: Obs,
             kw = dict(iters=remaining, chunk_rows=chunk_rows,
                       precision=config.kmeans_precision, timings=timings,
                       on_iter=_iter_done if want_iter_cb else None,
-                      pipeline_depth=config.pipeline_depth, obs=obs,
+                      pipeline_depth=obs.knob("pipeline_depth",
+                                              config.pipeline_depth),
+                      obs=obs,
                       # B is deliberately NOT checkpoint identity (see
                       # the meta above): outputs are bit-identical at
                       # any B, so a snapshot written at one B resumes
@@ -1014,7 +1021,8 @@ def _run_kmeans_body(config: JobConfig, obs: Obs,
                 mapped = pipelined(
                     (mapper.map_chunk(c) for c in
                      iter_point_chunks(config.input_path, rows)),
-                    config.pipeline_depth, obs, name="kmeans/map")
+                    obs.knob("pipeline_depth", config.pipeline_depth),
+                    obs, name="kmeans/map")
                 centroids = kmeans_iteration(
                     engine, centroids, (), mapper=mapper, mapped=mapped)
                 if want_iter_cb:
@@ -1160,7 +1168,9 @@ def _run_distinct_body(config: JobConfig, obs: Obs) -> DistinctResult:
 
     with obs.phase("map+reduce"):
         if file_iter is not None:
-            it = pipelined(file_iter, config.pipeline_depth, obs,
+            it = pipelined(file_iter,
+                           obs.knob("pipeline_depth",
+                                    config.pipeline_depth), obs,
                            name="map")
             for i, (out, next_off) in enumerate(it):
                 _ingest(out, next_off)
@@ -1170,7 +1180,9 @@ def _run_distinct_body(config: JobConfig, obs: Obs) -> DistinctResult:
             for idx, out in run_map_phase(chunks, mapper,
                                           config.num_map_workers,
                                           config.max_retries,
-                                          pipeline_depth=config.pipeline_depth,
+                                          pipeline_depth=obs.knob(
+                                              "pipeline_depth",
+                                              config.pipeline_depth),
                                           obs=obs):
                 gidx = resume_k + idx
                 _ingest(out, offsets.get(gidx))

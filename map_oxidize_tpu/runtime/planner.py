@@ -1,0 +1,265 @@
+"""Job planner: solve the tunable knobs from measured curves BEFORE the
+run, and say where every number came from.
+
+The auto dispatch-batch resolver (``runtime/dispatch.py``) proved the
+shape: a knob solved from measured inputs, with every input and its
+source recorded as evidence.  This module generalizes it to the whole
+knob surface — dispatch batch B, pipeline depth, chunk size, shuffle
+transport, sort sample — fed by the calibration store's cross-run
+curves (``obs/calib.py``) plus the workload's estimated shape (corpus
+bytes, estimated rows, device count).  Per-(payload, topology)
+decisions are *learned from measurement* rather than hard-coded (the
+portable-collectives argument, arXiv:2112.01075), and the plan commits
+to a number the run must bank: a predicted wall decomposed into the
+SAME attribution bucket names ``obs where`` reports (Exoshuffle's
+treat-the-overlap-budget-as-a-prediction discipline, arXiv:2203.05072).
+
+The output is a first-class **plan document** (``moxt-plan-v1``,
+``obs/plan.py``): one row per knob — chosen value + provenance +
+evidence — plus the predicted wall.  Provenance taxonomy:
+
+* ``pinned``  — the user set a non-default value; the planner records
+  it and keeps its hands off;
+* ``curve``   — solved (or confirmed) from the calibration store's
+  measured rows for this (platform, device-count, topology) identity;
+* ``memo``    — this process already resolved the knob and the memo
+  wins (the warm resident server's case — see dispatch's auto cache);
+* ``default`` — no measurement exists; the platform/config default is
+  recorded AS a default, never dressed up as a prediction.
+
+A cold run therefore carries overall provenance ``platform_default``
+and NO predicted wall (``plan/model_error_pct`` only exists when the
+plan actually predicted); a warm run predicts from the workload curve
+and is scored against the measured wall at finish.
+
+The planner never mutates the JobConfig (the ledger's config-hash
+identity must not depend on what the planner chose): solved values are
+applied through ``Obs.knob()`` (pipeline depth) and the dispatch
+resolver's own calibration-curve inputs (B), and advisory knobs record
+the value the engine will derive anyway.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+
+from map_oxidize_tpu.utils.logging import get_logger
+
+_log = get_logger(__name__)
+
+#: every knob a plan documents, in render order
+PLAN_KNOBS = ("dispatch_batch", "pipeline_depth", "chunk_bytes",
+              "shuffle_transport", "sort_sample")
+
+#: which jitted program each workload's batched streamed dispatch runs —
+#: auto-B is solved per program, and only the streamed k-means path has
+#: one today (the fold engine batches only under an explicit pin)
+_BATCH_PROGRAM = {"kmeans": "kmeans/stream_step"}
+
+#: record-model workloads: fixed 16-byte (u64, u64) rows — everything
+#: else is text at the shuffle router's conservative bytes/row estimate
+_RECORD_WORKLOADS = ("sort", "join", "sessionize")
+
+#: feed-wait share of wall (percent) above which the measured curve
+#: says the prefetch pipeline is too shallow — the device feed is
+#: visibly starving — and one more unit of depth is worth one more
+#: chunk of host RAM
+FEED_WAIT_DEEPEN_PCT = 15.0
+#: curve-driven depth ceiling: past ~4 chunks of readahead the producer
+#: threads are already saturated and extra depth only buys memory
+MAX_PLANNED_DEPTH = 4
+
+
+def solve_batch(floor_ms: float, compute_ms: float | None = None,
+                produce_ms: float | None = None, default_auto: int = 4,
+                max_b: int = 64) -> tuple[int, str]:
+    """The auto-B overlap roofline, shared by the dispatch resolver and
+    the planner's pre-solve: steady-state wall per chunk under double
+    buffering is ``max(produce, floor / B + compute)``, so pick the
+    smallest B that sinks the device side under the host side — or,
+    when the host is not the bottleneck (or produce is unknown),
+    amortize the floor against compute alone.  Returns ``(B, rule)``.
+    """
+    if compute_ms is None and produce_ms is None:
+        return max(1, min(default_auto, max_b)), "default_no_measurements"
+    comp = compute_ms or 0.0
+    headroom = (produce_ms - comp) if produce_ms is not None else None
+    if headroom is not None and headroom > 0.05:
+        # host-bound once overlapped: the smallest B whose launch
+        # floor sinks under the produce time
+        b = math.ceil(floor_ms / headroom)
+        rule = "overlap_host_produce"
+    else:
+        b = math.ceil(floor_ms / max(comp, 0.05))
+        rule = "amortize_vs_compute"
+    return max(1, min(b, max_b)), rule
+
+
+def estimate_shape(config, workload: str) -> dict:
+    """The workload's estimated shape — the planner's only job-side
+    inputs: corpus bytes (stat, 0 when unreadable), estimated rows
+    (the shuffle router's bytes/row model: 16 for fixed-width record
+    workloads, the same conservative 16 for text), and the chunk
+    count the chunker will derive."""
+    corpus = 0
+    try:
+        corpus = os.path.getsize(config.input_path)
+    except (OSError, TypeError):
+        pass
+    from map_oxidize_tpu.shuffle.base import AUTO_BYTES_PER_ROW
+
+    chunk = max(int(getattr(config, "chunk_bytes", 0) or 0), 1)
+    n_chunks = int(getattr(config, "num_chunks", 0) or 0)
+    if n_chunks <= 0 and corpus:
+        n_chunks = max(1, math.ceil(corpus / chunk))
+    return {
+        "corpus_bytes": corpus,
+        "est_rows": corpus // AUTO_BYTES_PER_ROW if corpus else 0,
+        "n_chunks": n_chunks,
+        "record_model": workload in _RECORD_WORKLOADS,
+    }
+
+
+def _pinned_knobs(config) -> set:
+    """Knobs the user overrode: any plan knob whose config value differs
+    from the dataclass default.  Derived from the config object itself
+    (not CLI parsing), so server submissions with JSON overrides and
+    one-shot CLI runs record pins identically."""
+    defaults = {f.name: f.default for f in dataclasses.fields(type(config))}
+    return {k for k in PLAN_KNOBS
+            if getattr(config, k, None) != defaults.get(k)}
+
+
+def build_plan(config, workload: str, calib_prior=None,
+               n_processes: int = 1) -> dict:
+    """Solve the plan document for one job: per-knob choices with
+    provenance + evidence, and — when the calibration store has a
+    workload curve for this identity — the predicted wall decomposed
+    into attribution buckets.  Read-only: consults the store and the
+    process memo, mutates neither the config nor the store."""
+    from map_oxidize_tpu.obs import calib as _calib
+    from map_oxidize_tpu.obs.plan import PLAN_SCHEMA
+
+    ident = _calib.run_identity(n_processes)
+    shape = estimate_shape(config, workload)
+    pins = _pinned_knobs(config)
+    wl_curve = _calib.workload_curve(calib_prior, ident, workload)
+
+    knobs: dict = {}
+
+    def _knob(name, value, provenance, evidence=None):
+        row = {"value": value, "provenance": provenance}
+        if evidence:
+            row["evidence"] = evidence
+        knobs[name] = row
+
+    # dispatch_batch — solved at the first streamed launch by the
+    # dispatch resolver; the plan records where its inputs will come
+    # from, pre-solving the roofline as evidence when a stored program
+    # curve exists (the resolver reads the same curve, so the numbers
+    # agree unless a live measurement beats the store at launch time)
+    from map_oxidize_tpu.runtime import dispatch as _dispatch
+
+    prog = _BATCH_PROGRAM.get(workload)
+    if "dispatch_batch" in pins:
+        _knob("dispatch_batch", config.dispatch_batch, "pinned",
+              {"requested": config.dispatch_batch})
+    elif prog is None:
+        _knob("dispatch_batch", config.dispatch_batch, "default",
+              {"note": f"{workload} has no batched streamed dispatch"})
+    else:
+        pcurve = _calib.program_curve(calib_prior, ident, prog)
+        if pcurve and pcurve.get("dispatch_ms_per_call"):
+            b, rule = solve_batch(
+                pcurve["dispatch_ms_per_call"],
+                pcurve.get("compute_ms_per_sample"), None,
+                _dispatch.DEFAULT_AUTO_B, _dispatch.MAX_AUTO_B)
+            _knob("dispatch_batch", 0, "curve", {
+                "program": prog,
+                "floor_ms": round(pcurve["dispatch_ms_per_call"], 4),
+                "curve_runs": pcurve.get("runs"),
+                "planned_b": b, "rule": rule})
+        elif _dispatch.has_any_cached_auto(prog):
+            _knob("dispatch_batch", 0, "memo",
+                  {"program": prog,
+                   "note": "process memo holds a resolved B"})
+        else:
+            _knob("dispatch_batch", 0, "default",
+                  {"program": prog,
+                   "note": "no stored curve; resolver will use "
+                           "platform-default floor"})
+
+    # pipeline_depth — the one knob the plan APPLIES (via Obs.knob):
+    # the workload curve's feed-wait share says whether the default
+    # depth keeps the device fed
+    depth = int(config.pipeline_depth)
+    if "pipeline_depth" in pins:
+        _knob("pipeline_depth", depth, "pinned", {"requested": depth})
+    elif wl_curve:
+        fw = wl_curve["buckets_ms_per_mb"].get("feed_wait", 0.0)
+        share = 100.0 * fw / max(wl_curve["wall_ms_per_mb"], 1e-9)
+        ev = {"feed_wait_share_pct": round(share, 2),
+              "curve_runs": wl_curve["runs"]}
+        if share > FEED_WAIT_DEEPEN_PCT and depth < MAX_PLANNED_DEPTH:
+            ev["deepened_from"] = depth
+            _knob("pipeline_depth", min(depth + 1, MAX_PLANNED_DEPTH),
+                  "curve", ev)
+        else:
+            _knob("pipeline_depth", depth, "curve", ev)
+    else:
+        _knob("pipeline_depth", depth, "default")
+
+    # chunk_bytes — advisory today (ROADMAP item 1's hook): record the
+    # chunk count it implies so the evidence is in place for a curve
+    _knob("chunk_bytes", int(config.chunk_bytes),
+          "pinned" if "chunk_bytes" in pins else "default",
+          {"n_chunks": shape["n_chunks"]} if shape["n_chunks"] else None)
+
+    # shuffle_transport — 'auto' already routes on measured-free shape
+    # (corpus vs cap); the plan records the route the engine will take
+    if "shuffle_transport" in pins:
+        _knob("shuffle_transport", config.shuffle_transport, "pinned",
+              {"requested": config.shuffle_transport})
+    else:
+        from map_oxidize_tpu.shuffle.base import resolve_transport
+
+        cap = int(getattr(config, "collect_max_rows", 0) or 0) or (1 << 27)
+        _knob("shuffle_transport", "auto", "default",
+              {"routes_to": resolve_transport(config, cap),
+               "est_rows": shape["est_rows"], "resident_cap": cap})
+
+    # sort_sample — advisory: the curve's host_sort share is the
+    # evidence a future splitter-count rule would consume
+    ev = None
+    if wl_curve and workload == "sort":
+        hs = wl_curve["buckets_ms_per_mb"].get("host_sort", 0.0)
+        ev = {"host_sort_share_pct": round(
+            100.0 * hs / max(wl_curve["wall_ms_per_mb"], 1e-9), 2)}
+    _knob("sort_sample", int(config.sort_sample),
+          "pinned" if "sort_sample" in pins else "default", ev)
+
+    doc = {
+        "schema": PLAN_SCHEMA,
+        "mode": getattr(config, "plan", "auto"),
+        "workload": workload,
+        "identity": ident,
+        "shape": shape,
+        "pins": sorted(pins),
+        "knobs": knobs,
+        "provenance": "platform_default",
+    }
+    if wl_curve and shape["corpus_bytes"] > 0:
+        mb = shape["corpus_bytes"] / (1 << 20)
+        doc["predicted"] = {
+            "wall_ms": round(wl_curve["wall_ms_per_mb"] * mb, 3),
+            "buckets": {name: round(rate * mb, 3)
+                        for name, rate
+                        in wl_curve["buckets_ms_per_mb"].items()},
+            "curve_runs": wl_curve["runs"],
+            "mean_curve_corpus_bytes": round(
+                wl_curve["mean_corpus_bytes"]),
+        }
+        doc["provenance"] = "curve"
+    return doc

@@ -156,6 +156,13 @@ class Scheduler:
         else:
             self.calib_dir = (cfg.calib_dir
                               or os.path.join(cfg.spool_dir, "calib"))
+        #: prediction errors (plan/model_error_pct) of the last few
+        #: finished jobs — the plan-model-drift SLO rule watches the
+        #: MEDIAN so a single noisy micro-job cannot trip it.  Only the
+        #: worker thread that finishes a job appends (under the
+        #: registry-publish path); bounded so a long-lived server
+        #: tracks recent fidelity, not its whole history.
+        self._plan_errors: list[float] = []
         self._workers = [
             threading.Thread(target=self._worker, daemon=True,
                              name=f"serve-worker-{i}")
@@ -484,6 +491,20 @@ class Scheduler:
             compiles = job.summary.get("compile/total_compiles") or 0
             if compiles > 0:
                 reg.count("serve/warm_compiles", compiles)
+        # plan observatory: fold this job's predicted-vs-actual wall
+        # error into the server-lifetime drift gauge.  Publish the
+        # MEDIAN of the last few finished jobs so the plan-model-drift
+        # SLO rule sees sustained staleness, not one noisy micro-job; a
+        # cold server (no warm-curve predictions yet) publishes nothing
+        # and the rule stays silent by construction.
+        if state == "done":
+            err = job.summary.get("plan/model_error_pct")
+            if isinstance(err, (int, float)):
+                self._plan_errors.append(float(err))
+                del self._plan_errors[:-8]
+                ranked = sorted(self._plan_errors)
+                reg.set("plan/model_error_pct",
+                        round(ranked[len(ranked) // 2], 2))
 
     def _prune_locked(self) -> None:
         """Bound the job history: a resident process must not grow RSS

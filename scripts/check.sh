@@ -456,6 +456,101 @@ print("dispatch-floor OK: 2 exact compiles at B=4, oracle parity, "
       f"auto resolved to B={led[2]['metrics']['dispatch/batch']}")
 EOF
 
+echo "== plan observatory smoke =="
+# ISSUE-18 acceptance: --plan auto must (1) record a COLD run's
+# platform_default provenance honestly (no pretend prediction), (2)
+# predict the wall from the warmed workload curve with the full plan
+# document riding the ledger entry and plan/model_error_pct under the
+# gate threshold, (3) render predicted-vs-actual via `obs plan`, (4)
+# record a user override as pinned provenance, and (5) fail
+# `obs diff --gate` with a NAMED reason when the calibration store's
+# curves are doctored — leaving the store file itself intact (the merge
+# only accumulates; it never rewrites history)
+for i in 1 2 3; do
+    JAX_PLATFORMS=cpu python -m map_oxidize_tpu wordcount \
+        "$smoke/corpus.txt" --output "$smoke/plan_out.txt" \
+        --num-shards 1 --plan auto --quiet \
+        --calib-dir "$smoke/plan_calib" \
+        --ledger-dir "$smoke/plan_ledger" \
+        --metrics-out "$smoke/plan_m$i.json" > /dev/null
+done
+python - "$smoke" <<'EOF'
+import json, sys
+d = sys.argv[1]
+led = [json.loads(l) for l in open(f"{d}/plan_ledger/ledger.jsonl")]
+assert len(led) == 3
+cold = led[0]["plan"]
+assert cold["provenance"] == "platform_default", cold
+assert "predicted" not in cold and "model_error_pct" not in cold
+assert led[0]["metrics"]["plan/pipeline_depth_provenance"] == "default"
+warm = led[2]["plan"]
+assert warm["provenance"] == "curve", warm
+assert warm["predicted"]["wall_ms"] > 0
+assert warm["actual"]["wall_ms"] > 0
+# predicted buckets use the SAME names obs where attributes
+assert set(warm["predicted"]["buckets"]) <= set(warm["actual"]["buckets"])
+err = led[2]["metrics"]["plan/model_error_pct"]
+assert err == warm["model_error_pct"] and err < 50.0, \
+    f"same-corpus warm prediction should be close, got {err}%"
+print(f"plan OK: cold=platform_default, warm predicted "
+      f"{warm['predicted']['wall_ms']:.0f}ms vs actual "
+      f"{warm['actual']['wall_ms']:.0f}ms ({err}% error)")
+EOF
+# healthy warm-vs-warm ledger pair passes the gate, and the report renders
+python -m map_oxidize_tpu obs diff --ledger-dir "$smoke/plan_ledger" \
+    --gate > /dev/null
+python -m map_oxidize_tpu obs plan "$smoke/plan_m3.json" | head -7
+# a user override must ride the plan as a PIN (metrics-out only: the
+# changed config hash makes it a different ledger identity by design)
+JAX_PLATFORMS=cpu python -m map_oxidize_tpu wordcount \
+    "$smoke/corpus.txt" --output "$smoke/plan_out.txt" --num-shards 1 \
+    --plan auto --pipeline-depth 3 --quiet \
+    --calib-dir "$smoke/plan_calib" \
+    --metrics-out "$smoke/plan_pinned.json" > /dev/null
+python - "$smoke" <<'EOF'
+import json, sys
+d = sys.argv[1]
+plan = json.load(open(f"{d}/plan_pinned.json"))["plan"]
+assert plan["pins"] == ["pipeline_depth"], plan["pins"]
+row = plan["knobs"]["pipeline_depth"]
+assert row == {"value": 3, "provenance": "pinned",
+               "evidence": {"requested": 3}}, row
+print("plan OK: override recorded as pinned provenance")
+EOF
+# doctor the store's workload curve (x50 wall rates, identity fields
+# untouched so it still LOADS — a plausibly-stale store, not a torn one)
+python - "$smoke" <<'EOF'
+import json, sys
+p = f"{sys.argv[1]}/plan_calib/calib.json"
+doc = json.load(open(p))
+for row in doc["workloads"].values():
+    row["wall_ms"] *= 50.0
+    for k in [k for k in row
+              if k.startswith("bucket_") and k.endswith("_ms")]:
+        row[k] *= 50.0
+json.dump(doc, open(p, "w"))
+EOF
+JAX_PLATFORMS=cpu python -m map_oxidize_tpu wordcount \
+    "$smoke/corpus.txt" --output "$smoke/plan_out.txt" --num-shards 1 \
+    --plan auto --quiet --calib-dir "$smoke/plan_calib" \
+    --ledger-dir "$smoke/plan_ledger" \
+    --metrics-out "$smoke/plan_m4.json" > /dev/null
+if python -m map_oxidize_tpu obs diff --ledger-dir "$smoke/plan_ledger" \
+    --gate > "$smoke/plan_gate.txt" 2>&1; then
+    echo "doctored-store run should have tripped the plan gate"
+    cat "$smoke/plan_gate.txt"
+    exit 1
+fi
+grep -q "plan model drift" "$smoke/plan_gate.txt"
+python - "$smoke" <<'EOF'
+import json, sys
+doc = json.load(open(f"{sys.argv[1]}/plan_calib/calib.json"))
+row = next(iter(doc["workloads"].values()))
+assert row["wall_ms"] > 1e4, "store must survive the gate run intact"
+print("plan OK: doctored store tripped the gate with a named reason; "
+      "store file left intact")
+EOF
+
 echo "== live telemetry smoke =="
 # a big-enough HIGH-CARDINALITY corpus (the native mapper pre-combines
 # per chunk, so a repeated-words corpus stages too few rows to flush

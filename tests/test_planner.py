@@ -1,0 +1,488 @@
+"""Plan observatory (ISSUE-18 tentpole): the predicted-vs-actual
+planning loop and its prediction-error gate.
+
+Layers covered:
+
+* the shared auto-B roofline (``planner.solve_batch``) — rule selection
+  and clamping, exactly the math the dispatch resolver applies;
+* shape estimation and pin detection from the config object;
+* ``build_plan`` provenance — a cold plan records ``platform_default``
+  and NO prediction, a pinned override records ``pinned``, a fabricated
+  calibration curve yields ``curve`` provenance with a per-MB-scaled
+  predicted wall and the feed-wait deepen rule (capped);
+* ``obs.plan`` publish/finalize/render — gauges, the error math, and
+  the report text;
+* the calibration store's workload rows — accumulate/curve round-trip,
+  numeric merge, and the doctored-key refusal;
+* the read-side curve APIs (``program_curve``,
+  ``interpolate_latency_ms``);
+* the ledger gate (points, not relative percent; missing baseline is
+  unknown, not zero), the trend direction, the critpath headline's
+  guarded fidelity gauge, the ``plan-model-drift`` default SLO rule,
+  and the ``plan/dispatch_*`` gauge aliases.
+"""
+
+import dataclasses
+import math
+
+import pytest
+
+from map_oxidize_tpu.config import JobConfig
+from map_oxidize_tpu.obs import calib as calib_mod
+from map_oxidize_tpu.obs import plan as plan_mod
+from map_oxidize_tpu.obs.calib import CalibMismatch, CalibStore
+from map_oxidize_tpu.obs.metrics import MetricsRegistry
+from map_oxidize_tpu.runtime import planner
+
+IDENT = {"platform": "host", "device_count": 0, "topology": "1x0"}
+
+
+def _attrib(wall_ms, buckets):
+    attributed = sum(buckets.values())
+    return {
+        "schema": "moxt-attrib-v1",
+        "wall_ms": wall_ms,
+        "attributed_ms": attributed,
+        "unattributed_ms": wall_ms - attributed,
+        "unattributed_pct": 100.0 * (wall_ms - attributed) / wall_ms,
+        "buckets": {name: {"ms": ms, "pct": 100.0 * ms / wall_ms}
+                    for name, ms in buckets.items()},
+    }
+
+
+def _store_with_workload(workload="wordcount", corpus_bytes=float(1 << 20),
+                         wall_ms=1000.0, buckets=None, ident=None):
+    store = CalibStore()
+    n = store.accumulate_workload(
+        ident or IDENT, workload, corpus_bytes,
+        _attrib(wall_ms, buckets if buckets is not None
+                else {"device_compute": 600.0, "feed_wait": 100.0}))
+    assert n == 1
+    return store
+
+
+# --- solve_batch (the shared roofline) -------------------------------------
+
+
+def test_solve_batch_no_measurements_uses_default():
+    b, rule = planner.solve_batch(150.0)
+    assert (b, rule) == (4, "default_no_measurements")
+    b, _ = planner.solve_batch(150.0, default_auto=100, max_b=64)
+    assert b == 64
+
+
+def test_solve_batch_overlap_host_produce():
+    # produce 20ms, compute 5ms: headroom 15ms -> B = ceil(150/15) = 10
+    b, rule = planner.solve_batch(150.0, compute_ms=5.0, produce_ms=20.0)
+    assert (b, rule) == (10, "overlap_host_produce")
+
+
+def test_solve_batch_amortize_vs_compute():
+    # no produce measurement: amortize the floor against compute alone
+    b, rule = planner.solve_batch(150.0, compute_ms=40.0)
+    assert (b, rule) == (math.ceil(150.0 / 40.0), "amortize_vs_compute")
+    # device-bound (produce < compute) takes the same rule
+    b, rule = planner.solve_batch(150.0, compute_ms=40.0, produce_ms=10.0)
+    assert rule == "amortize_vs_compute"
+
+
+def test_solve_batch_clamps():
+    b, _ = planner.solve_batch(1e6, compute_ms=0.1, max_b=64)
+    assert b == 64
+    b, _ = planner.solve_batch(0.0, compute_ms=1e9)
+    assert b == 1
+
+
+# --- shape + pins -----------------------------------------------------------
+
+
+def test_estimate_shape(tmp_path):
+    corpus = tmp_path / "c.txt"
+    corpus.write_bytes(b"x" * 4096)
+    cfg = JobConfig(input_path=str(corpus))
+    shape = planner.estimate_shape(cfg, "wordcount")
+    assert shape["corpus_bytes"] == 4096
+    assert shape["est_rows"] == 4096 // 16
+    assert shape["n_chunks"] == 1
+    assert shape["record_model"] is False
+    assert planner.estimate_shape(cfg, "sort")["record_model"] is True
+    # unreadable input: zeros, never a raise
+    missing = planner.estimate_shape(
+        JobConfig(input_path=str(tmp_path / "nope")), "wordcount")
+    assert missing["corpus_bytes"] == 0 and missing["est_rows"] == 0
+
+
+def test_pinned_knobs_from_config_defaults():
+    assert planner._pinned_knobs(JobConfig()) == set()
+    assert planner._pinned_knobs(
+        JobConfig(pipeline_depth=3)) == {"pipeline_depth"}
+    assert planner._pinned_knobs(
+        JobConfig(sort_sample=128, shuffle_transport="disk")) == {
+            "sort_sample", "shuffle_transport"}
+
+
+# --- build_plan provenance --------------------------------------------------
+
+
+def test_cold_plan_is_platform_default(tmp_path):
+    corpus = tmp_path / "c.txt"
+    corpus.write_bytes(b"x" * 8192)
+    doc = planner.build_plan(JobConfig(input_path=str(corpus)),
+                             "wordcount", calib_prior=None)
+    assert doc["schema"] == plan_mod.PLAN_SCHEMA
+    assert doc["provenance"] == "platform_default"
+    assert "predicted" not in doc
+    assert doc["pins"] == []
+    assert set(doc["knobs"]) == set(planner.PLAN_KNOBS)
+    for row in doc["knobs"].values():
+        assert row["provenance"] in plan_mod.PROVENANCES
+
+
+def test_pinned_override_recorded_as_pin(tmp_path):
+    corpus = tmp_path / "c.txt"
+    corpus.write_bytes(b"x" * 8192)
+    doc = planner.build_plan(
+        JobConfig(input_path=str(corpus), pipeline_depth=3),
+        "wordcount", calib_prior=None)
+    assert doc["pins"] == ["pipeline_depth"]
+    row = doc["knobs"]["pipeline_depth"]
+    assert row["value"] == 3
+    assert row["provenance"] == "pinned"
+    assert row["evidence"] == {"requested": 3}
+
+
+def test_warm_plan_predicts_and_scales(tmp_path):
+    corpus = tmp_path / "c.txt"
+    corpus.write_bytes(b"x" * (2 << 20))  # 2 MB vs a 1 MB curve
+    ident = calib_mod.run_identity()
+    store = _store_with_workload(wall_ms=1000.0, ident=ident)
+    doc = planner.build_plan(JobConfig(input_path=str(corpus)),
+                             "wordcount", calib_prior=store)
+    assert doc["provenance"] == "curve"
+    pred = doc["predicted"]
+    # per-MB rate 1000ms/MB x 2MB corpus
+    assert pred["wall_ms"] == pytest.approx(2000.0)
+    assert pred["buckets"]["device_compute"] == pytest.approx(1200.0)
+    assert pred["curve_runs"] == 1
+    # low feed-wait share (10%): the curve CONFIRMS the default depth
+    row = doc["knobs"]["pipeline_depth"]
+    assert row["provenance"] == "curve"
+    assert row["value"] == JobConfig().pipeline_depth
+    assert row["evidence"]["feed_wait_share_pct"] == pytest.approx(10.0)
+
+
+def test_warm_plan_deepens_on_feed_wait_and_caps(tmp_path):
+    corpus = tmp_path / "c.txt"
+    corpus.write_bytes(b"x" * (1 << 20))
+    ident = calib_mod.run_identity()
+    starved = {"device_compute": 300.0, "feed_wait": 400.0}  # 40% share
+    store = _store_with_workload(wall_ms=1000.0, buckets=starved,
+                                 ident=ident)
+    doc = planner.build_plan(JobConfig(input_path=str(corpus)),
+                             "wordcount", calib_prior=store)
+    row = doc["knobs"]["pipeline_depth"]
+    assert row["value"] == JobConfig().pipeline_depth + 1
+    assert row["provenance"] == "curve"
+    assert row["evidence"]["deepened_from"] == JobConfig().pipeline_depth
+    # at the ceiling the curve stops deepening (depth 4 is a PIN here,
+    # so provenance flips to pinned and the value holds)
+    doc = planner.build_plan(
+        JobConfig(input_path=str(corpus),
+                  pipeline_depth=planner.MAX_PLANNED_DEPTH),
+        "wordcount", calib_prior=store)
+    assert (doc["knobs"]["pipeline_depth"]["value"]
+            == planner.MAX_PLANNED_DEPTH)
+
+
+# --- obs.plan publish / finalize / render -----------------------------------
+
+
+class _FakeObs:
+    def __init__(self):
+        self.registry = MetricsRegistry()
+
+
+def test_publish_flattens_plan_gauges(tmp_path):
+    corpus = tmp_path / "c.txt"
+    corpus.write_bytes(b"x" * 8192)
+    doc = planner.build_plan(JobConfig(input_path=str(corpus)),
+                             "wordcount", calib_prior=None)
+    reg = MetricsRegistry()
+    plan_mod.publish(reg, doc)
+    assert reg.gauges["plan/mode"] == "auto"
+    assert reg.gauges["plan/provenance"] == "platform_default"
+    assert reg.gauges["plan/pipeline_depth"] == 2
+    assert reg.gauges["plan/pipeline_depth_provenance"] == "default"
+    assert "plan/predicted_wall_ms" not in reg.gauges
+    plan_mod.publish(None, doc)  # bare-registry callers never raise
+
+
+def test_finalize_scores_prediction():
+    doc = {"predicted": {"wall_ms": 1500.0, "buckets": {}},
+           "provenance": "curve"}
+    obs = _FakeObs()
+    out = plan_mod.finalize(obs, doc, _attrib(1000.0,
+                                              {"device_compute": 700.0}))
+    assert out["actual"]["wall_ms"] == 1000.0
+    assert out["actual"]["buckets"]["device_compute"] == 700.0
+    assert out["model_error_pct"] == pytest.approx(50.0)
+    assert obs.registry.gauges["plan/model_error_pct"] == 50.0
+    assert obs.registry.gauges["plan/actual_wall_ms"] == 1000.0
+
+
+def test_finalize_cold_plan_attaches_actual_without_error():
+    doc = {"provenance": "platform_default"}
+    obs = _FakeObs()
+    out = plan_mod.finalize(obs, doc, _attrib(800.0, {"compile": 500.0}))
+    assert out["actual"]["wall_ms"] == 800.0
+    assert "model_error_pct" not in out
+    assert "plan/model_error_pct" not in obs.registry.gauges
+    # no attribution (crashed before finalize): doc passes through
+    assert plan_mod.finalize(obs, {"x": 1}, None) == {"x": 1}
+
+
+def test_render_warm_and_cold(tmp_path):
+    corpus = tmp_path / "c.txt"
+    corpus.write_bytes(b"x" * (1 << 20))
+    ident = calib_mod.run_identity()
+    store = _store_with_workload(ident=ident)
+    doc = planner.build_plan(JobConfig(input_path=str(corpus)),
+                             "wordcount", calib_prior=store)
+    plan_mod.finalize(_FakeObs(), doc,
+                      _attrib(900.0, {"device_compute": 500.0}))
+    text = plan_mod.render(doc)
+    assert "plan vs actual: wordcount" in text
+    assert "model error" in text
+    assert "[curve  ]" in text
+    assert "predicted" in text and "actual" in text
+    cold = planner.build_plan(JobConfig(input_path=str(corpus)),
+                              "wordcount", calib_prior=None)
+    plan_mod.finalize(_FakeObs(), cold,
+                      _attrib(900.0, {"device_compute": 500.0}))
+    assert "no prediction (platform_default)" in plan_mod.render(cold)
+
+
+# --- calibration store: workload rows ---------------------------------------
+
+
+def test_accumulate_workload_and_curve_roundtrip():
+    store = _store_with_workload(corpus_bytes=float(2 << 20),
+                                 wall_ms=500.0,
+                                 buckets={"host_sort": 200.0})
+    curve = calib_mod.workload_curve(store, IDENT, "wordcount")
+    assert curve["runs"] == 1
+    assert curve["wall_ms_per_mb"] == pytest.approx(250.0)
+    assert curve["buckets_ms_per_mb"]["host_sort"] == pytest.approx(100.0)
+    assert curve["mean_corpus_bytes"] == pytest.approx(float(2 << 20))
+    assert calib_mod.workload_curve(store, IDENT, "sort") is None
+    assert calib_mod.workload_curve(None, IDENT, "wordcount") is None
+
+
+def test_accumulate_workload_refuses_unusable_runs():
+    store = CalibStore()
+    ok = _attrib(100.0, {"compile": 50.0})
+    assert store.accumulate_workload(IDENT, "", 1024.0, ok) == 0
+    assert store.accumulate_workload(IDENT, "wc", 1024.0, None) == 0
+    assert store.accumulate_workload(IDENT, "wc", 0.0, ok) == 0
+    assert store.accumulate_workload(
+        IDENT, "wc", 1024.0, {"wall_ms": 0.0}) == 0
+    assert "workloads" not in store.doc
+
+
+def test_workload_rows_merge_numerically():
+    a = _store_with_workload(corpus_bytes=float(1 << 20), wall_ms=100.0)
+    b = _store_with_workload(corpus_bytes=float(1 << 20), wall_ms=300.0)
+    a.merge_from(b.doc)
+    row = next(iter(a.doc["workloads"].values()))
+    assert row["runs"] == 2
+    assert row["wall_ms"] == pytest.approx(400.0)
+    assert row["corpus_bytes"] == pytest.approx(float(2 << 20))
+    # identity fields survived the numeric merge untouched
+    assert row["workload"] == "wordcount"
+    assert row["device_count"] == IDENT["device_count"]
+    calib_mod.validate_doc(a.doc)
+
+
+def test_doctored_workload_key_refuses():
+    store = _store_with_workload()
+    key = next(iter(store.doc["workloads"]))
+    row = store.doc["workloads"].pop(key)
+    store.doc["workloads"][key.replace("wordcount", "sort")] = row
+    with pytest.raises(CalibMismatch, match="torn/doctored"):
+        calib_mod.validate_doc(store.doc)
+    clean = CalibStore()
+    with pytest.raises(CalibMismatch):
+        clean.merge_from(store.doc)
+
+
+# --- read-side curves -------------------------------------------------------
+
+
+def test_program_curve_reads_per_call_rates():
+    store = CalibStore()
+    key = calib_mod._prog_key(IDENT, "kmeans/stream_step")
+    store.doc["programs"][key] = dict(
+        IDENT, program="kmeans/stream_step", dispatches=10,
+        dispatch_ms=80.0, compute_ms=30.0, compute_samples=10,
+        compiles=1, compile_ms=100.0, runs=2)
+    curve = calib_mod.program_curve(store, IDENT, "kmeans/stream_step")
+    assert curve["dispatch_ms_per_call"] == pytest.approx(8.0)
+    assert curve["compute_ms_per_sample"] == pytest.approx(3.0)
+    assert curve["runs"] == 2
+    assert calib_mod.program_curve(store, IDENT, "other") is None
+    assert calib_mod.program_curve(None, IDENT, "x") is None
+
+
+def test_interpolate_latency_log_linear_and_clamped():
+    store = CalibStore()
+    for nbytes, lat, bucket in ((1024.0, 1.0, "1KB"),
+                                (1024.0 * 1024, 3.0, "1MB")):
+        key = calib_mod._comm_key(IDENT, "psum", "p", bucket)
+        store.doc["comms"][key] = dict(
+            IDENT, collective="psum", program="p", shape_bucket=bucket,
+            calls=4, bytes=nbytes * 4, latency_ms=lat * 4,
+            latency_samples=4, runs=1)
+    f = calib_mod.interpolate_latency_ms
+    assert f(store, IDENT, "psum", 1024.0) == pytest.approx(1.0)
+    assert f(store, IDENT, "psum", 1.0) == pytest.approx(1.0)  # clamp lo
+    assert f(store, IDENT, "psum", 1e9) == pytest.approx(3.0)  # clamp hi
+    # geometric midpoint of a log-linear curve: halfway latency
+    assert f(store, IDENT, "psum", 32768.0) == pytest.approx(2.0)
+    assert f(store, IDENT, "other", 1024.0) is None
+    assert f(store, IDENT, "psum", 1024.0, program="q") is None
+
+
+# --- ledger gate, trend, critpath, SLO rule ---------------------------------
+
+
+def _entry(metrics):
+    return {"workload": "wordcount", "config_hash": "h", "version": "v",
+            "corpus_bytes": 1, "n_processes": 1, "phases_s": {},
+            "metrics": metrics}
+
+
+def test_ledger_gate_plan_model_error_points():
+    from map_oxidize_tpu.obs.ledger import diff_entries
+
+    lo = 5.0
+    hi = lo + plan_mod.PLAN_ERROR_GATE_POINTS + 25.0
+    d = diff_entries(_entry({"plan/model_error_pct": lo}),
+                     _entry({"plan/model_error_pct": hi}), force=True)
+    assert any("plan model drift" in r for r in d["regressions"])
+    ok = diff_entries(_entry({"plan/model_error_pct": lo}),
+                      _entry({"plan/model_error_pct": lo + 25.0}),
+                      force=True)
+    assert not any("plan model" in r for r in ok["regressions"])
+    # no baseline (first warm run after a cold one) is unknown, not 0
+    fresh = diff_entries(_entry({}), _entry({"plan/model_error_pct": hi}),
+                         force=True)
+    assert not any("plan model" in r for r in fresh["regressions"])
+    # improving error never flags
+    better = diff_entries(_entry({"plan/model_error_pct": hi}),
+                          _entry({"plan/model_error_pct": lo}), force=True)
+    assert not any("plan model" in r for r in better["regressions"])
+
+
+def test_trend_ranks_model_error_up_is_bad():
+    from map_oxidize_tpu.obs.trend import _direction
+
+    assert _direction("plan/model_error_pct", 40.0) == "regressed"
+    assert _direction("plan/model_error_pct", -40.0) == "improved"
+    assert _direction("critpath/model_error_pct", 40.0) == "regressed"
+
+
+def test_critpath_headline_model_error_guarded():
+    from map_oxidize_tpu.obs.critpath import headline
+
+    doc = {"blame": {}, "slack": {}, "degenerate": True, "wall_ms": 100.0,
+           "segments": [{"ms": 60.0}], "bound_by": "x",
+           "path_over_wall_pct": 100.0, "model_error_pct": 7.5}
+    assert headline(doc)["critpath/model_error_pct"] == 7.5
+    del doc["model_error_pct"]
+    assert "critpath/model_error_pct" not in headline(doc)
+
+
+def test_scheduler_publishes_median_plan_error(tmp_path):
+    # the plan-model-drift rule watches the MEDIAN of recently finished
+    # jobs, so one noisy micro-job cannot trip it; a server that never
+    # saw a warm prediction publishes nothing (silent by construction)
+    from map_oxidize_tpu.config import ServeConfig
+    from map_oxidize_tpu.serve.scheduler import Scheduler
+
+    class _Job:
+        started_unix_s = None
+        finished_unix_s = None
+        submitted_unix_s = 0.0
+        first_deferred_unix_s = None
+
+        def __init__(self, summary):
+            self.summary = summary
+
+    sch = Scheduler(ServeConfig(spool_dir=str(tmp_path)))
+    sch.server_registry = MetricsRegistry()
+    for err in (10.0, 12.0, 900.0):
+        sch._record_slo_metrics(_Job({"plan/model_error_pct": err}),
+                                "done", 1)
+    assert sch.server_registry.gauges["plan/model_error_pct"] == 12.0
+    # a cold job (no prediction) neither publishes nor clears
+    sch._record_slo_metrics(_Job({}), "done", 1)
+    assert sch.server_registry.gauges["plan/model_error_pct"] == 12.0
+    cold = Scheduler(ServeConfig(spool_dir=str(tmp_path / "cold")))
+    cold.server_registry = MetricsRegistry()
+    cold._record_slo_metrics(_Job({}), "done", 0)
+    assert "plan/model_error_pct" not in cold.server_registry.gauges
+
+
+def test_plan_model_drift_slo_rule():
+    from map_oxidize_tpu.obs.slo import DEFAULT_RULES, SloRule
+
+    rules = [SloRule(**r) for r in DEFAULT_RULES]
+    drift = [r for r in rules if r.name == "plan-model-drift"]
+    assert len(drift) == 1
+    drift[0].validate()
+    assert drift[0].metric == "plan/model_error_pct"
+    assert drift[0].scope == "serve"
+    assert drift[0].evidence == "plan/predicted_wall_ms"
+
+
+# --- gauge namespaces + knob application ------------------------------------
+
+
+def test_record_dispatch_batch_writes_plan_aliases():
+    from map_oxidize_tpu.runtime.dispatch import record_dispatch_batch
+
+    reg = MetricsRegistry()
+    record_dispatch_batch(reg, 8, {"mode": "auto", "rule": "r",
+                                   "floor_ms": 2.5})
+    # primary planner namespace and the historical alias agree
+    assert reg.gauges["plan/dispatch_batch"] == 8
+    assert reg.gauges["dispatch/batch"] == 8
+    assert reg.gauges["plan/dispatch_batch_mode"] == "auto"
+    assert reg.gauges["dispatch/batch_mode"] == "auto"
+    assert reg.gauges["plan/dispatch_floor_ms"] == 2.5
+    assert reg.gauges["dispatch/floor_ms"] == 2.5
+
+
+def test_obs_knob_prefers_plan_value():
+    from map_oxidize_tpu.obs import Obs, Tracer
+
+    obs = Obs(registry=MetricsRegistry(), tracer=Tracer(enabled=False))
+    assert obs.knob("pipeline_depth", 2) == 2
+    obs.plan = {"knobs": {"pipeline_depth": {"value": 3,
+                                             "provenance": "curve"}}}
+    assert obs.knob("pipeline_depth", 2) == 3
+    assert obs.knob("chunk_bytes", 7) == 7  # absent knob: fallback
+
+
+def test_config_validates_plan_mode():
+    JobConfig(plan="off").validate()
+    with pytest.raises(ValueError, match="plan must be"):
+        JobConfig(plan="maybe").validate()
+
+
+def test_plan_field_is_dataclass_default_auto():
+    # _pinned_knobs depends on dataclass defaults staying the source of
+    # truth; guard the knob surface against silent renames
+    names = {f.name for f in dataclasses.fields(JobConfig)}
+    assert set(planner.PLAN_KNOBS) <= names
+    assert "plan" in names
