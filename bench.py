@@ -1324,6 +1324,25 @@ def _bench_workloads(run_job, JobConfig, probes=None) -> dict:
         else:
             out["inverted_index_2proc_spill"] = entry
 
+    # --- pipelined push shuffle (ISSUE-19): the map-side combiner A-B
+    # (comms bytes must drop, output byte-identical) and a skewed
+    # 2-process reduce under the push transport (nonzero shuffle
+    # overlap + barrier-transport parity gated); shuffle/push_* ride
+    # each entry's metrics_snapshot for the ledger
+    for name, fn in (("wordcount_combined", _bench_wordcount_combined),
+                     ("skewed_reduce_2proc_pipelined",
+                      _bench_2proc_pipelined)):
+        _release_heap()
+        try:
+            entry = fn(slice_path)
+        except Exception as e:
+            out[f"{name}_error"] = f"{type(e).__name__}: {e}"
+        else:
+            if "error" in entry:
+                out[f"{name}_error"] = entry["error"]
+            else:
+                out[name] = entry
+
     # --- dataflow workloads (ISSUE-14): total-order sort + hash
     # equi-join, oracle-parity-enforced, riding the same ledger gate
     # (comms/compile/spill fields in metrics_snapshot)
@@ -1511,7 +1530,11 @@ def _bench_2proc_spill(corpus: str) -> dict:
     env = dict(os.environ)
     for k in ("PALLAS_AXON_POOL_IPS", "PJRT_LIBRARY_PATH",
               "TPU_LIBRARY_PATH", "PJRT_DEVICE", "TPU_ACCELERATOR_TYPE",
-              "TPU_TOPOLOGY", "TPU_WORKER_HOSTNAMES"):
+              "TPU_TOPOLOGY", "TPU_WORKER_HOSTNAMES",
+              # see _launch_2proc_wordcount: a warm persistent-cache hit
+              # replays a wrong-device-assignment executable in the
+              # 2-process mesh and mis-routes the collectives
+              "JAX_COMPILATION_CACHE_DIR"):
         env.pop(k, None)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
@@ -1576,6 +1599,194 @@ def _bench_2proc_spill(corpus: str) -> dict:
                 "parity enforced (detail entry; gate-watched via "
                 "metrics_snapshot spill counters)",
         "metrics_snapshot": snapshot,
+    }
+
+
+def _launch_2proc_wordcount(corpus: str, out_path: str, metrics_out: str,
+                            extra_flags: list) -> "float | str":
+    """Run one 2-process Gloo CPU-mesh wordcount (the same DCN-path
+    harness as ``_bench_2proc_spill``); returns wall seconds or an error
+    string.  Output partitions land at ``<out_path>.part{i}of2`` and
+    per-process metrics at ``<metrics_out>.proc{i}``."""
+    import socket
+    import subprocess
+    import sys as _sys
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = dict(os.environ)
+    for k in ("PALLAS_AXON_POOL_IPS", "PJRT_LIBRARY_PATH",
+              "TPU_LIBRARY_PATH", "PJRT_DEVICE", "TPU_ACCELERATOR_TYPE",
+              "TPU_TOPOLOGY", "TPU_WORKER_HOSTNAMES",
+              # the persistent XLA cache is poison for multi-process
+              # children: a warm hit replays an executable whose device
+              # assignment was baked for a DIFFERENT process's view of
+              # the Gloo mesh, mis-routing the collectives (keys land on
+              # wrong shards; the n_unique conservation check aborts)
+              "JAX_COMPILATION_CACHE_DIR"):
+        env.pop(k, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    t0 = time.perf_counter()
+    procs = [subprocess.Popen(
+        [_sys.executable, "-m", "map_oxidize_tpu", "wordcount", corpus,
+         "--output", out_path, "--quiet",
+         "--dist-coordinator", f"127.0.0.1:{port}",
+         "--dist-processes", "2", "--dist-process-id", str(p),
+         "--metrics-out", metrics_out] + extra_flags,
+        env=env, cwd=REPO, stdout=subprocess.DEVNULL,
+        stderr=subprocess.STDOUT) for p in range(2)]
+    try:
+        for p in procs:
+            p.wait(timeout=900)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        for p in procs:
+            p.wait()
+        return "2-proc wordcount timed out (children killed)"
+    if any(p.returncode != 0 for p in procs):
+        return f"2-proc wordcount aborted (rc={[p.returncode for p in procs]})"
+    return time.perf_counter() - t0
+
+
+def _read_2proc_snaps(metrics_out: str) -> list:
+    snaps = []
+    for i in range(2):
+        with open(f"{metrics_out}.proc{i}") as f:
+            doc = json.load(f)
+        snaps.append(dict(doc.get("counters", {}), **doc.get("gauges", {})))
+    return snaps
+
+
+def _comms_bytes(snaps: list) -> int:
+    return sum(int(v) for s in snaps for k, v in s.items()
+               if k.startswith("comms/") and k.endswith("/bytes"))
+
+
+def _bench_wordcount_combined(corpus: str) -> dict:
+    """``wordcount_combined``: the map-side combiner A-B on a 2-process
+    pipelined-push wordcount — ON must move comms/*/bytes measurably
+    DOWN (the push windows collapse duplicate keys before rows travel)
+    while the output partitions stay byte-identical.  Detail entry on
+    the forced CPU mesh, same harness as ``_bench_2proc_spill``."""
+    runs = {}
+    for mode in ("on", "off"):
+        out_p = os.path.join(CACHE_DIR, f"wc_comb_{mode}.txt")
+        met_p = os.path.join(CACHE_DIR, f"wc_comb_{mode}_metrics.json")
+        # small merge batches so the corpus spans many exchange rounds —
+        # the combiner's win IS fewer rounds (each moves a fixed buffer)
+        got = _launch_2proc_wordcount(
+            corpus, out_p, met_p,
+            ["--shuffle-transport", "pipelined", "--push-combine", mode,
+             "--batch-size", "4096", "--chunk-mb", "1"])
+        if isinstance(got, str):
+            return {"error": f"combiner={mode}: {got}"}
+        runs[mode] = {"secs": got, "out": out_p,
+                      "snaps": _read_2proc_snaps(met_p)}
+    for i in range(2):
+        a = open(f"{runs['on']['out']}.part{i}of2", "rb").read()
+        b = open(f"{runs['off']['out']}.part{i}of2", "rb").read()
+        if a != b:
+            return {"error": "combiner on/off output parity FAILED "
+                             f"(partition {i})"}
+    bytes_on = _comms_bytes(runs["on"]["snaps"])
+    bytes_off = _comms_bytes(runs["off"]["snaps"])
+    if not (0 < bytes_on < bytes_off):
+        return {"error": "combiner ON did not reduce comms bytes "
+                         f"({bytes_on} vs OFF {bytes_off})"}
+    snap_on = runs["on"]["snaps"][0]
+    keep = ("shuffle/", "comms/", "pipeline/", "dist/")
+    return {
+        "on_s": round(runs["on"]["secs"], 3),
+        "off_s": round(runs["off"]["secs"], 3),
+        "comms_bytes_on": bytes_on,
+        "comms_bytes_off": bytes_off,
+        "comms_bytes_saved_pct": round(
+            100.0 * (bytes_off - bytes_on) / bytes_off, 2),
+        "push_combined_in": sum(
+            int(s.get("shuffle/push_combined_in", 0))
+            for s in runs["on"]["snaps"]),
+        "push_combined_out": sum(
+            int(s.get("shuffle/push_combined_out", 0))
+            for s in runs["on"]["snaps"]),
+        "note": "2-process pipelined-push wordcount, map-side combiner "
+                "A-B: byte-identical output, comms bytes gated down",
+        "metrics_snapshot": {k: v for k, v in snap_on.items()
+                             if k.startswith(keep)},
+    }
+
+
+def _bench_2proc_pipelined(corpus: str) -> dict:
+    """``skewed_reduce_2proc_pipelined``: a 2-process reduce over a
+    hot-key-skewed corpus under the push transport — the shuffle wall
+    the critpath's ``map_shuffle_overlapped`` what-if predicted hides
+    behind map.  Gates: byte parity vs the barrier (hbm) transport and
+    ``pipeline/shuffle_overlap_ratio`` > 0 on every process; the
+    ``shuffle/push_*`` counters ride metrics_snapshot for the ledger."""
+    skew_path = os.path.join(CACHE_DIR, "skewed_wc.txt")
+    if not os.path.isfile(skew_path):
+        # ~16MB, one hot key at ~50% mass plus a 512-word tail: the shape
+        # where eager pushes matter (the hot partition dominates rounds);
+        # big enough that each process maps several 1MB chunks, so the
+        # producer genuinely runs ahead of the lockstep exchange
+        rng = np.random.default_rng(7)
+        words = np.array([b"hotkey"] + [f"w{i:04d}".encode()
+                                        for i in range(512)], dtype=object)
+        draw = rng.integers(0, 513, (160_000, 16))
+        draw[:, ::2] = 0  # every other slot is the hot key
+        with open(skew_path, "wb") as f:
+            for row in words[draw]:
+                f.write(b" ".join(row) + b"\n")
+    runs = {}
+    # combiner OFF here on purpose: this entry isolates the push
+    # pipeline's overlap (merge rounds interleaving with production —
+    # ON would collapse the low-vocab skew to one end-of-stream round);
+    # wordcount_combined is the combiner's own A-B
+    base = ["--batch-size", "2048", "--chunk-mb", "1",
+            "--push-combine", "off"]
+    for name, flags in (("hbm", base + ["--shuffle-transport", "hbm"]),
+                        ("pipelined",
+                         base + ["--shuffle-transport", "pipelined"])):
+        out_p = os.path.join(CACHE_DIR, f"wc_skew_{name}.txt")
+        met_p = os.path.join(CACHE_DIR, f"wc_skew_{name}_metrics.json")
+        got = _launch_2proc_wordcount(skew_path, out_p, met_p, flags)
+        if isinstance(got, str):
+            return {"error": f"{name}: {got}"}
+        runs[name] = {"secs": got, "out": out_p,
+                      "snaps": _read_2proc_snaps(met_p)}
+    for i in range(2):
+        a = open(f"{runs['hbm']['out']}.part{i}of2", "rb").read()
+        b = open(f"{runs['pipelined']['out']}.part{i}of2", "rb").read()
+        if a != b:
+            return {"error": "pipelined vs barrier transport parity "
+                             f"FAILED (partition {i})"}
+    snaps = runs["pipelined"]["snaps"]
+    ratios = [float(s.get("pipeline/shuffle_overlap_ratio", 0.0))
+              for s in snaps]
+    # gate on the max: chunks round-robin across the 2 processes, so the
+    # process holding fewer rounds can legitimately sit at ~0 overlap
+    if max(ratios) <= 0.0:
+        return {"error": "push pipeline never overlapped "
+                         f"(shuffle_overlap_ratio={ratios})"}
+    if not all(int(s.get("shuffle/push_rounds", 0)) > 0 for s in snaps):
+        return {"error": "pipelined run recorded no push rounds"}
+    keep = ("shuffle/", "comms/", "pipeline/", "dist/", "critpath/")
+    return {
+        "best_s": round(runs["pipelined"]["secs"], 3),
+        "barrier_s": round(runs["hbm"]["secs"], 3),
+        "overlap_ratio": [round(r, 4) for r in ratios],
+        "push_rounds": sum(int(s.get("shuffle/push_rounds", 0))
+                           for s in snaps),
+        "push_rows": sum(int(s.get("shuffle/push_rows", 0))
+                         for s in snaps),
+        "transport": snaps[0].get("shuffle/transport"),
+        "note": "2-process skewed reduce, push transport vs barrier: "
+                "byte parity + nonzero shuffle overlap gated",
+        "metrics_snapshot": {k: v for k, v in snaps[0].items()
+                             if k.startswith(keep)},
     }
 
 

@@ -142,9 +142,33 @@ def build_parser() -> argparse.ArgumentParser:
                         "first row (bounded residency; distributed "
                         "processes spill their disjoint hash partitions "
                         "locally), hybrid = resident until the cap then "
-                        "demote to disk mid-job. auto routes on corpus "
-                        "size vs --collect-max-rows (estimated rows past "
-                        "the cap pick disk, else hybrid)")
+                        "demote to disk mid-job, pipelined = hybrid's "
+                        "placement plus an eager push cadence (each "
+                        "mapped block is partitioned and merged while "
+                        "map still produces; see --push-combine), "
+                        "remote = stage in a shared-filesystem object "
+                        "layout a surviving peer can finish the job "
+                        "from after a process dies mid-shuffle. auto "
+                        "routes on corpus size vs --collect-max-rows "
+                        "(estimated rows past the cap pick disk, else "
+                        "hybrid)")
+    p.add_argument("--push-combine", choices=["auto", "on", "off"],
+                   default="auto",
+                   help="map-side combiner for the pipelined push "
+                        "shuffle: combine each push window's partial "
+                        "fold states (sum/min/max reducers) before the "
+                        "exchange, so aggregation workloads push "
+                        "combined partials instead of raw pairs. auto = "
+                        "on when the transport resolves to pipelined/"
+                        "remote; outputs are byte-identical either way")
+    p.add_argument("--remote-stage-dir", default="",
+                   help="remote transport: shared-filesystem stage "
+                        "directory every process can reach (default: "
+                        "<output>.stage)")
+    p.add_argument("--remote-stage-timeout", type=float, default=60.0,
+                   help="remote transport: seconds to wait for peers' "
+                        "final stage manifests before declaring them "
+                        "dead and taking over their partitions")
     p.add_argument("--join-input", default="",
                    help="join: the RIGHT/probe record corpus (.npy of "
                         "(u64 key, u64 payload) rows, payloads < 2^63; "
@@ -331,6 +355,9 @@ def config_from_args(args: argparse.Namespace) -> JobConfig:
         sort_sample=args.sort_sample,
         collect_max_rows=args.collect_max_rows,
         shuffle_transport=args.shuffle_transport,
+        push_combine=args.push_combine,
+        remote_stage_dir=args.remote_stage_dir,
+        remote_stage_timeout_s=args.remote_stage_timeout,
         plan=args.plan,
         hll_precision=args.hll_precision,
         kmeans_k=args.kmeans_k,

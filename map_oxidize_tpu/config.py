@@ -263,8 +263,33 @@ class JobConfig:
     #: Applies to single-controller AND multi-process pair collect (each
     #: distributed process spills its disjoint hash partition locally —
     #: the old at-cap abort is gone); the fold engines bound DISTINCT
-    #: keys, not staged rows, and are unaffected.
+    #: keys, not staged rows, and are unaffected.  'pipelined' = hybrid's
+    #: placement plus the push cadence: each fed block is hash-partitioned
+    #: and eagerly merged into its owner WHILE map still produces (the
+    #: prefetcher overlaps map with the exchange rounds; see
+    #: ``push_combine`` for the map-side combiner riding it).  'remote' =
+    #: staged from the first row like disk, but multi-process fold runs
+    #: stage in a shared-filesystem object layout (moxt-shuffle-stage-v1
+    #: manifests, ``remote_stage_dir``) from which a surviving peer can
+    #: finish the job after a process dies mid-shuffle.
     shuffle_transport: str = "auto"
+    #: map-side combiner for the pipelined push shuffle: 'auto' combines
+    #: each push window's partial fold states when the transport resolves
+    #: to pipelined/remote and the reducer's combine is an associative
+    #: scalar monoid (sum/min/max — wordcount pushes ~27k combined
+    #: partials instead of millions of raw pairs), 'on' forces it for any
+    #: eligible reducer regardless of transport, 'off' disables it.  The
+    #: conservation checksums are sum-combine-invariant, so audits stay
+    #: green either way; outputs are byte-identical.
+    push_combine: str = "auto"
+    #: remote transport: the shared-filesystem stage directory every
+    #: process of the job can reach.  Empty = derived as
+    #: ``<output_path>.stage``.
+    remote_stage_dir: str = ""
+    #: remote transport: how long a process waits for its peers' final
+    #: stage manifests before declaring them dead and taking over their
+    #: partitions from the staged objects.
+    remote_stage_timeout_s: float = 60.0
     #: job planner (runtime/planner.py + obs/plan.py): 'auto' solves the
     #: tunable knobs from the calibration store's measured curves before
     #: the run and emits the plan document — per-knob value + provenance
@@ -328,6 +353,13 @@ class JobConfig:
             raise ValueError(
                 f"shuffle_transport must be one of {'|'.join(TRANSPORTS)}, "
                 f"got {self.shuffle_transport!r}")
+        if self.push_combine not in ("auto", "on", "off"):
+            raise ValueError(
+                f"push_combine must be auto|on|off, "
+                f"got {self.push_combine!r}")
+        if self.remote_stage_timeout_s <= 0:
+            raise ValueError(
+                "remote_stage_timeout_s must be positive seconds")
         # disk + collect_sort='device' is rejected by the single-chip
         # engine, not here: on a sharded mesh the combination is valid
         # (collect_sort applies to the single-chip engine only) and only

@@ -303,9 +303,13 @@ def build_status(obs, config, workload: str | None = None) -> dict:
         # transport is a per-job fact — collect-engine jobs set it)
         transport = obs.registry.gauges.get("shuffle/transport")
         spill = {k: v for k, v in obs.registry.counters.items()
-                 if k.startswith(("spill/", "demote/"))}
+                 if k.startswith(("spill/", "demote/", "shuffle/push_",
+                                  "shuffle/remote_"))}
         if transport is not None or spill:
-            doc["shuffle"] = dict(spill, transport=transport)
+            from map_oxidize_tpu.shuffle.base import TRANSPORTS
+
+            doc["shuffle"] = dict(spill, transport=transport,
+                                  transports=list(TRANSPORTS))
     doc["comms"] = obs.registry.comms_table()
     # live wall attribution: the same decomposition the obs where CLI
     # renders post-hoc, computed against the running overlay.  The

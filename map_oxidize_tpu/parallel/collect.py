@@ -83,7 +83,7 @@ class ShardedCollectEngine:
 
     def __init__(self, config: JobConfig, mesh=None, bucket_cap: int = 0,
                  max_rows: int = 1 << 27, splitters=None,
-                 pair_order: str = "stable"):
+                 pair_order: str = "stable", transport: str | None = None):
         from map_oxidize_tpu.shuffle import make_transport, resolve_transport
 
         self.config = config
@@ -120,8 +120,10 @@ class ShardedCollectEngine:
         #: placement policy (map_oxidize_tpu.shuffle): hybrid = device
         #: buffers until the cap then demote toward disk, disk = skip the
         #: device entirely and stage in buckets from the first row, hbm =
-        #: strictly resident (the cap raises)
-        self.transport = resolve_transport(config, max_rows)
+        #: strictly resident (the cap raises).  Callers that applied the
+        #: planner's knob (Obs.knob seam) pass the resolved name.
+        self.transport = (transport if transport is not None
+                          else resolve_transport(config, max_rows))
         self._transport = make_transport(self.transport)
         self.rows_fed = 0
         self._obs = None               # obs.Obs injected by the driver
@@ -202,7 +204,10 @@ class ShardedCollectEngine:
             out_specs=(row2,) * 4,
         )))
 
-        if self.transport == "disk":
+        if self.transport in ("disk", "remote"):
+            # remote on the single-controller path stages exactly like
+            # disk (the shared-filesystem object layout only pays off
+            # across processes — see shuffle/remote.py)
             self._activate_disk_transport()
 
     # observability: the bundle must reach whichever level currently

@@ -50,6 +50,7 @@ from map_oxidize_tpu.api import MapOutput, SumReducer
 from map_oxidize_tpu.config import JobConfig
 from map_oxidize_tpu.obs import Obs
 from map_oxidize_tpu.ops.hashing import SENTINEL
+from map_oxidize_tpu.shuffle.base import resolve_transport
 from map_oxidize_tpu.parallel.collect import (
     ShardedCollectEngine as ShardedCollectEngineBase,
 )
@@ -913,6 +914,27 @@ def run_distributed_job(config: JobConfig, workload: str
     import jax
 
     config.validate()
+    # --- remote-staged dispatch, BEFORE any collective or engine
+    # construction: the remote transport coordinates through the shared
+    # filesystem only (manifest + atomic rename, shuffle/remote.py), so
+    # a peer that dies mid-shuffle must not be able to wedge this
+    # process inside a jax collective.  Such jobs may run WITHOUT
+    # jax.distributed at all — each process a single-controller runtime
+    # whose identity comes from the config fields the launcher sets.
+    n_proc = jax.process_count()
+    proc = jax.process_index()
+    if n_proc == 1 and config.dist_num_processes > 1:
+        n_proc = config.dist_num_processes
+        proc = max(config.dist_process_id, 0)
+    cap = int(config.collect_max_rows or 0) or (1 << 27)
+    if resolve_transport(config, cap) == "remote" and n_proc > 1:
+        if workload not in ("wordcount", "bigram"):
+            raise ValueError(
+                "the remote shuffle transport supports fold workloads "
+                f"(wordcount, bigram), not {workload!r}")
+        obs = Obs.from_config(config, process=proc, n_processes=n_proc)
+        with obs.recording(config, workload):
+            return _run_remote_staged(config, workload, obs, proc, n_proc)
     obs = Obs.from_config(config, process=jax.process_index(),
                           n_processes=jax.process_count())
     with obs.recording(config, workload):
@@ -941,6 +963,14 @@ def _run_distributed_core(config: JobConfig, workload: str, obs: Obs
     registry = obs.registry
     use_native = resolve_mapper(config, workload) == "native"
     doc_mode = workload == "invertedindex"
+    # the planner's shuffle_transport knob resolves through the same
+    # router the engines use (a pin still wins inside resolve_transport:
+    # the knob value IS the pin when one was requested)
+    cap = int(config.collect_max_rows or 0) or (1 << 27)
+    transport = resolve_transport(
+        config, cap, name=obs.knob("shuffle_transport",
+                                   config.shuffle_transport))
+    push_mode = transport == "pipelined"
     if workload == "wordcount":
         mapper, reducer = make_wordcount(config.tokenizer, use_native)
         engine = DistributedReduceEngine(config, reducer)
@@ -955,7 +985,8 @@ def _run_distributed_core(config: JobConfig, workload: str, obs: Obs
         from map_oxidize_tpu.runtime.driver import collect_engine_kw
 
         mapper = make_inverted_index(config.tokenizer, config.use_native)
-        engine = DistributedCollectEngine(config, **collect_engine_kw(config))
+        engine = DistributedCollectEngine(config, transport=transport,
+                                          **collect_engine_kw(config))
     else:
         raise ValueError(f"unknown distributed workload {workload!r}")
     engine.obs = obs
@@ -963,6 +994,10 @@ def _run_distributed_core(config: JobConfig, workload: str, obs: Obs
         # the /status shuffle section + ledger entries name the active
         # transport (collect engines only; fold engines have none)
         registry.set("shuffle/transport", engine.transport)
+    elif push_mode:
+        # fold engines carry no transport object, but the push cadence
+        # is still theirs — name it for /status and the ledger
+        registry.set("shuffle/transport", "pipelined")
     P_ = engine.n_proc
     dictionary = HashDictionary()
     # data-plane audit over the GLOBAL shard partition: every process
@@ -1035,7 +1070,38 @@ def _run_distributed_core(config: JobConfig, workload: str, obs: Obs
                                      bytes_done=base + len(chunk))
             yield out
 
-    source = _produce()
+    # --- push cadence: under the pipelined transport the producer runs
+    # ahead of the lockstep exchange — chunk k+1 maps on the prefetcher
+    # thread while round k's flag-psum + merge_local occupy this one.
+    # The overlap the critical path's map_shuffle_overlapped what-if
+    # predicted is banked here; pipeline/shuffle_overlap_ratio reports
+    # how much of the feed actually hid behind the exchange.
+    if push_mode:
+        from map_oxidize_tpu.runtime.pipeline import pipelined
+
+        source = pipelined(
+            _produce(),
+            max(2, int(obs.knob("pipeline_depth", config.pipeline_depth))),
+            obs, name="push",
+            ratio_gauge="pipeline/shuffle_overlap_ratio")
+    else:
+        source = _produce()
+
+    # --- map-side combiner: sum-combine (min/max alike) partial fold
+    # states per push window before they stage.  The data-plane audit
+    # digests the RAW rows first — conservation checksums are
+    # sum-combine-invariant, so the audit stays green while comms/*
+    # bytes drop.  Pair mode carries (doc, pos) payloads; never combined.
+    from map_oxidize_tpu.shuffle.pipelined import (
+        COMBINABLE,
+        combine_map_output,
+        record_push_combine,
+    )
+
+    do_combine = (not doc_mode
+                  and config.push_combine != "off"
+                  and (config.push_combine == "on" or push_mode)
+                  and reducer.combine in COMBINABLE)
 
     def _pop_block():
         nonlocal staged
@@ -1048,6 +1114,18 @@ def _run_distributed_core(config: JobConfig, workload: str, obs: Obs
             hi = np.empty(0, np.uint32)
             lo = np.empty(0, np.uint32)
             va = np.empty((0, 2) if doc_mode else 0, vals_dtype)
+        if do_combine and hi.shape[0]:
+            # the push-window combine: the native mapper already folds
+            # WITHIN a chunk, so the reduction that matters happens here,
+            # across the whole staged window, just before rows travel.
+            # The audit digested the raw rows at staging — the weighted
+            # checksum is sum-combine-invariant, so conservation holds.
+            win = MapOutput(hi=hi, lo=lo, values=va, records_in=0)
+            win, c_in, c_out = combine_map_output(win, reducer.combine)
+            if c_out < c_in:  # identity windows recount nothing
+                record_push_combine(obs, c_in, c_out)
+                hi, lo = win.hi, win.lo
+                va = np.asarray(win.values)
         take = min(engine.local_rows, hi.shape[0])
         staged_outs[:] = [MapOutput(
             hi=hi[take:], lo=lo[take:], values=va[take:],
@@ -1067,14 +1145,37 @@ def _run_distributed_core(config: JobConfig, workload: str, obs: Obs
                     exhausted = True
                     break
                 dictionary.update(out.dictionary)
-                staged_outs.append(out)
-                staged += len(out)
                 records += out.records_in
                 if dp is not None and len(out):
                     rows = _dp.map_output_rows(out, pairs=doc_mode)
                     if rows is not None:
                         (dp.record_pairs_in if doc_mode
                          else dp.record_fold_in)(*rows)
+                staged_outs.append(out)
+                staged += len(out)
+                if do_combine and staged >= engine.local_rows:
+                    # collapse the staged window in place: if duplicates
+                    # fold away, `staged` drops below local_rows and the
+                    # loop keeps pulling — so the block that finally
+                    # travels carries up to local_rows DISTINCT keys and
+                    # the exchange-round count (the comms/*/bytes driver:
+                    # each merge moves a fixed [S, cap] buffer) shrinks
+                    # by the window's duplication factor.  Identity
+                    # windows leave `staged` untouched and exit the loop,
+                    # so re-combining cost amortizes to one sort per
+                    # local_rows raw rows.
+                    hi = np.concatenate([o.hi for o in staged_outs])
+                    lo = np.concatenate([o.lo for o in staged_outs])
+                    va = np.concatenate([np.asarray(o.values)
+                                         for o in staged_outs])
+                    win = MapOutput(hi=hi, lo=lo, values=va,
+                                    records_in=0)
+                    win, c_in, c_out = combine_map_output(
+                        win, reducer.combine)
+                    if c_out < c_in:
+                        record_push_combine(obs, c_in, c_out)
+                        staged_outs[:] = [win]
+                        staged = c_out
             have = staged > 0
             t0 = _time.perf_counter()
             # round= is the lockstep sequence tag: every process runs
@@ -1095,6 +1196,12 @@ def _run_distributed_core(config: JobConfig, workload: str, obs: Obs
             if not cont:
                 break
             blk = _pop_block()
+            if push_mode:
+                # one push round = one eagerly-exchanged block; rows
+                # count what actually traveled (post-combine)
+                registry.count("shuffle/push_rounds")
+                registry.count("shuffle/push_rows",
+                               int(blk[0].shape[0]))
             with obs.tracer.span("dist/merge_local",
                                  rows=int(blk[0].shape[0]),
                                  round=flag_rounds - 1):
@@ -1218,6 +1325,195 @@ def _run_distributed_core(config: JobConfig, workload: str, obs: Obs
     _log.info("distributed %s: %d processes, %d local records, %d keys, "
               "%d lockstep flag rounds (%.3fs)", workload, P_, records,
               result.n_keys, flag_rounds, flag_s)
+    return result
+
+
+def _run_remote_staged(config: JobConfig, workload: str, obs: Obs,
+                       proc: int, n_proc: int) -> DistributedResult:
+    """Fold workloads over the remote-staged transport
+    (:mod:`map_oxidize_tpu.shuffle.remote`): map + map-side combine +
+    stage to the shared filesystem, then a collective-free drain.
+
+    The lockstep loop and its flag-psum are deliberately ABSENT — every
+    cross-process edge here is a manifest on the shared filesystem, so a
+    peer SIGKILLed mid-shuffle cannot wedge this process inside a
+    collective.  After staging, each process waits (bounded by
+    ``remote_stage_timeout_s``) for peers' ``final`` manifests; a peer
+    that never goes final is claimed by exactly one survivor
+    (``claim.proc<d>``, O_CREAT|O_EXCL), which re-maps the chunks absent
+    from the dead peer's last committed manifest into a recovery stage.
+    Every process then drains all partitions (replicated
+    :class:`DistributedResult`, same contract as the lockstep core),
+    verifies each against the manifest-summed weighted checksum — the
+    PR 16 conservation identity carried by files instead of an
+    allgather — and writes the output partitions it is responsible for
+    (its own, plus any dead peer's it claimed)."""
+    import os
+    import time as _time
+
+    from map_oxidize_tpu.obs.dataplane import ConservationError, mix64
+    from map_oxidize_tpu.ops.hashing import HashDictionary, join_u64
+    from map_oxidize_tpu.runtime import resolve_mapper
+    from map_oxidize_tpu.shuffle.pipelined import (
+        COMBINABLE,
+        combine_map_output,
+        record_push_combine,
+    )
+    from map_oxidize_tpu.shuffle.remote import (
+        RemoteStage,
+        claim_dead_proc,
+        read_manifest,
+        read_partition,
+        read_strings,
+        stage_root,
+        wait_for_finals,
+    )
+    from map_oxidize_tpu.workloads.bigram import make_bigram
+    from map_oxidize_tpu.workloads.wordcount import make_wordcount
+
+    registry = obs.registry
+    registry.set("shuffle/transport", "remote")
+    use_native = resolve_mapper(config, workload) == "native"
+    maker = make_wordcount if workload == "wordcount" else make_bigram
+    mapper, reducer = maker(config.tokenizer, use_native)
+    ufunc = COMBINABLE[reducer.combine]
+    do_combine = (config.push_combine != "off"
+                  and reducer.combine in COMBINABLE)
+    root = stage_root(config)
+    os.makedirs(root, exist_ok=True)
+
+    def _stage_owned(owner: int, skip_chunks: "set[int]",
+                     stage: RemoteStage) -> "tuple[HashDictionary, int]":
+        """Map + combine + stage every chunk ``owner`` owns that is not
+        already manifest-committed; returns the strings dictionary and
+        record count of what THIS call mapped."""
+        dictionary = HashDictionary()
+        records = 0
+        for _idx, chunk, base in _local_chunks(config, owner, n_proc,
+                                               False, 0):
+            if _idx in skip_chunks:
+                continue
+            with obs.tracer.span("dist/map_chunk", index=_idx,
+                                 bytes=len(chunk)):
+                out = mapper.map_chunk(bytes(chunk))
+                out.ensure_planes()
+            dictionary.update(out.dictionary)
+            records += out.records_in
+            if do_combine and len(out):
+                out, c_in, c_out = combine_map_output(out, reducer.combine)
+                record_push_combine(obs, c_in, c_out)
+            k64 = (out.keys64 if out.keys64 is not None
+                   else join_u64(out.hi, out.lo))
+            va = (np.ones(len(out), np.int64) if out.values is None
+                  else np.asarray(out.values))
+            with obs.tracer.span("shuffle/remote_stage", index=_idx,
+                                 rows=int(k64.shape[0])):
+                # strings BEFORE the chunk commit: a committed chunk's
+                # keys must be resolvable even if this process dies on
+                # the very next instruction (dupes across chunks are
+                # harmless — read_strings last-writes the same bytes)
+                stage.stage_strings(out.dictionary)
+                stage.append_chunk(_idx, k64, va, records=out.records_in)
+            if obs.heartbeat is not None:
+                obs.heartbeat.update(rows=out.records_in,
+                                     bytes_done=base + len(chunk))
+        stage.finish()
+        return dictionary, records
+
+    with obs.phase("map+stage"):
+        _, records = _stage_owned(proc, set(),
+                                  RemoteStage(root, proc, n_proc, obs=obs))
+
+    # --- the filesystem rendezvous: peers' final manifests, or takeover
+    responsible = {proc}
+    with obs.phase("stage_wait"):
+        manifests, dead = wait_for_finals(
+            root, n_proc, proc, config.remote_stage_timeout_s)
+    manifests[proc] = read_manifest(root, proc)
+    for d in dead:
+        if claim_dead_proc(root, d, proc):
+            _log.warning("process %d claimed dead peer %d: re-mapping "
+                         "its un-staged chunks", proc, d)
+            registry.count("shuffle/remote_takeovers")
+            done = set((manifests.get(d) or {}).get("chunks_done", ()))
+            with obs.phase("recover"):
+                _stage_owned(d, done,
+                             RemoteStage(root, proc, n_proc, obs=obs,
+                                         owner=d))
+            responsible.add(d)
+        else:
+            # another survivor won the claim; wait for ITS recovery
+            # manifest to go final before draining (its re-mapped rows
+            # feed every partition, including ours)
+            deadline = (_time.monotonic()
+                        + max(config.remote_stage_timeout_s, 1.0))
+            while True:
+                rec = read_manifest(root, d, recovery=True)
+                if rec is not None and rec.get("final"):
+                    break
+                if _time.monotonic() >= deadline:
+                    raise RuntimeError(
+                        f"peer {d} died and its claimant never finished "
+                        "recovery within the stage timeout")
+                _time.sleep(0.25)
+    for d in dead:
+        rec = read_manifest(root, d, recovery=True)
+        if rec is not None:
+            manifests[n_proc + d] = rec  # distinct key; drains sum all
+
+    # --- collective-free drain: every partition, checksum-verified
+    counts: dict = {}
+    with obs.phase("drain+reduce"):
+        for q in range(n_proc):
+            keys, vals, want = read_partition(root, manifests, q)
+            if keys.shape[0]:
+                order = np.argsort(keys, kind="stable")
+                ks, vs = keys[order], vals[order]
+                bounds = np.flatnonzero(
+                    np.concatenate([[True], ks[1:] != ks[:-1]]))
+                uniq = ks[bounds]
+                folded = ufunc.reduceat(vs, bounds)
+                got = int((mix64(uniq) * folded.view(np.uint64))
+                          .sum(dtype=np.uint64))
+            else:
+                uniq = np.empty(0, np.uint64)
+                folded = np.empty(0, np.int64)
+                got = 0
+            if got != want:
+                raise ConservationError(
+                    f"remote-staged partition {q} drained checksum "
+                    f"{got:#x} != manifest sum {want:#x}: staged rows "
+                    "were lost or duplicated")
+            registry.count("shuffle/remote_partitions_drained")
+            counts.update(zip(uniq.tolist(),
+                              (int(v) for v in folded.tolist())))
+    words = read_strings(root)
+    order = sorted(counts, key=lambda h: (-counts[h], h))[:config.top_k]
+    top = [(h, words.get(h), counts[h]) for h in order]
+
+    if config.output_path:
+        from map_oxidize_tpu.io.writer import write_final_result
+
+        with obs.phase("write"):
+            for q in sorted(responsible):
+                owned = sorted(
+                    (words[h], h) for h in counts
+                    if h % n_proc == q and h in words)
+                write_final_result(
+                    partition_output_path(config.output_path, q, n_proc),
+                    ((b, counts[h]) for b, h in owned))
+    # the stage directory is deliberately left in place: peers drain at
+    # their own pace (no rendezvous to delete behind), and after a
+    # takeover it IS the recovery evidence
+    registry.set("records_in", records)
+    registry.set("flag_rounds", 0)
+    result = DistributedResult(
+        counts=counts, top=top, n_keys=len(counts), records=records)
+    result.metrics, result.trace = finish_distributed_obs(obs, config,
+                                                          workload)
+    _log.info("remote-staged %s: process %d/%d, %d local records, "
+              "%d global keys, %d dead peers recovered", workload, proc,
+              n_proc, records, len(counts), len(dead))
     return result
 
 

@@ -112,13 +112,14 @@ class CollectEngine:
         self.max_rows = max_rows
         self.transport = (transport if transport is not None
                           else resolve_transport(config, max_rows))
-        if self.transport == "disk" and self.sort_mode == "device":
-            if config.shuffle_transport == "disk":
+        if (self.transport in ("disk", "remote")
+                and self.sort_mode == "device"):
+            if config.shuffle_transport in ("disk", "remote"):
                 raise ValueError(
-                    "shuffle_transport='disk' stages rows in host disk "
-                    "buckets, which the single-chip collect_sort="
-                    "'device' (HBM-resident sort) cannot consume; use "
-                    "collect_sort host/auto")
+                    f"shuffle_transport={config.shuffle_transport!r} "
+                    "stages rows in host disk buckets, which the "
+                    "single-chip collect_sort='device' (HBM-resident "
+                    "sort) cannot consume; use collect_sort host/auto")
             # an AUTO-routed disk falls back to the resident policy the
             # device sort can actually honor
             _log.info("auto-routed shuffle_transport='disk' does not "
@@ -167,11 +168,13 @@ class CollectEngine:
         if self.sort_mode == "host":
             action = self._transport.admit(self.rows_fed, self.max_rows,
                                            "pair collect (CollectEngine)")
-            if action != "resident":
+            if action in ("demote", "spill"):
                 # 'demote' and 'spill' converge here: _begin_spill drains
                 # whatever staged residently (nothing yet, for 'disk')
                 # into the buckets, then this and every later block
-                # spills on arrival
+                # spills on arrival.  'push' (the pipelined transport's
+                # under-cap verdict) stays resident — the eager-merge
+                # cadence is the driver's half
                 self._begin_spill(demote=action == "demote")
         elif self.rows_fed > self.max_rows:
             raise RuntimeError(

@@ -25,6 +25,7 @@ from map_oxidize_tpu.ops.hashing import SENTINEL, HashDictionary, join_u64
 from map_oxidize_tpu.runtime.engine import DeviceReduceEngine, StreamingEngineBase
 from map_oxidize_tpu.runtime.executor import run_map_phase
 from map_oxidize_tpu.runtime.pipeline import pipelined
+from map_oxidize_tpu.shuffle.base import resolve_transport
 from map_oxidize_tpu.utils.logging import get_logger
 
 _log = get_logger(__name__)
@@ -69,8 +70,20 @@ def collect_engine_kw(config: JobConfig) -> dict:
             if config.collect_max_rows else {})
 
 
+def solved_transport(config: JobConfig, obs: Obs) -> str:
+    """The one route from the planner's ``shuffle_transport`` knob to a
+    concrete transport name: the knob value (a pin still wins — the
+    planner echoes pins verbatim) resolves through the same router the
+    engines use, so driver-level cadence decisions (push pipelining,
+    map-side combining) and the engine's placement agree."""
+    cap = int(config.collect_max_rows or 0) or (1 << 27)
+    return resolve_transport(config, cap,
+                             name=obs.knob("shuffle_transport",
+                                           config.shuffle_transport))
+
+
 def make_engine(config: JobConfig, reducer, value_shape=(), value_dtype=np.int32,
-                wide_keys: bool = False):
+                wide_keys: bool = False, transport: str | None = None):
     """Pick the engine: shard count selects single-chip vs the all_to_all
     mesh engine, and ``reduce_mode`` (or the mapper's ``wide_keys``
     declaration under 'auto') selects the streaming fold vs the host
@@ -97,6 +110,7 @@ def make_engine(config: JobConfig, reducer, value_shape=(), value_dtype=np.int32
             return HostCollectReduceEngine(config, reducer,
                                            value_shape=value_shape,
                                            value_dtype=value_dtype,
+                                           transport=transport,
                                            **collect_engine_kw(config))
     if n <= 1:
         return DeviceReduceEngine(config, reducer, value_shape=value_shape,
@@ -288,14 +302,33 @@ def _run_wordcount_body(config: JobConfig, obs: Obs, mapper: Mapper,
                         reducer: Reducer, workload: str) -> JobResult:
     metrics = obs.registry
 
+    # the planner's shuffle_transport knob (Obs.knob seam, same as
+    # pipeline_depth) picks the transport; pins still win inside the
+    # resolver.  'pipelined' turns on the push cadence: the map pipeline
+    # runs under the push/* span names + overlap gauge, and the map-side
+    # combiner collapses each push window before the feed.
+    transport = solved_transport(config, obs)
+    push_mode = transport == "pipelined"
     engine = make_engine(config, reducer,
                          value_shape=mapper.value_shape,
                          value_dtype=mapper.value_dtype,
-                         wide_keys=getattr(mapper, "wide_keys", False))
+                         wide_keys=getattr(mapper, "wide_keys", False),
+                         transport=transport)
     engine.obs = obs
     if getattr(engine, "transport", None):
         # collect engines carry a shuffle transport; fold engines don't
         metrics.set("shuffle/transport", engine.transport)
+    elif push_mode:
+        metrics.set("shuffle/transport", "pipelined")
+    from map_oxidize_tpu.shuffle.pipelined import (
+        COMBINABLE,
+        combine_map_output,
+        record_push_combine,
+    )
+
+    do_combine = (config.push_combine != "off"
+                  and (config.push_combine == "on" or push_mode)
+                  and reducer.combine in COMBINABLE)
     # data-plane audit over the engine's hash partitions (virtual ones
     # when the engine has no shards): conservation, skew, reduction
     dp = obs.ensure_dataplane(
@@ -337,6 +370,12 @@ def _run_wordcount_body(config: JobConfig, obs: Obs, mapper: Mapper,
             rows = map_output_rows(out, pairs=False)
             if rows is not None:  # scalar fold rows only (not k-means)
                 dp.record_fold_in(*rows)
+        if do_combine and len(out):
+            # map-side combine AFTER the audit digested the raw rows:
+            # the weighted checksum is sum-combine-invariant, so the
+            # conservation verdict is unchanged while the feed shrinks
+            out, c_in, c_out = combine_map_output(out, reducer.combine)
+            record_push_combine(obs, c_in, c_out)
         if mapper.keys_have_dictionary:
             # the dictionary covers every key fed so far, so its size bounds
             # distinct keys — growth needs no device sync.  upper_bound
@@ -407,11 +446,17 @@ def _run_wordcount_body(config: JobConfig, obs: Obs, mapper: Mapper,
     # i's engine feed + dispatch below; order is preserved, so the
     # checkpoint spill and the output are byte-identical to depth 1.
     with obs.phase("map+reduce"):
+        depth = obs.knob("pipeline_depth", config.pipeline_depth)
+        if push_mode:
+            # the push cadence needs a producer actually running ahead:
+            # depth >= 2, push/* span names for the critpath's push-edge
+            # handoffs, and the overlap-ratio gauge the bench gates on
+            depth = max(2, int(depth))
         if native_file_iter is not None:
-            it = pipelined(native_file_iter,
-                           obs.knob("pipeline_depth",
-                                    config.pipeline_depth), obs,
-                           name="map")
+            it = pipelined(native_file_iter, depth, obs,
+                           name="push" if push_mode else "map",
+                           ratio_gauge=("pipeline/shuffle_overlap_ratio"
+                                        if push_mode else None))
             for i, (out, next_off) in enumerate(it):
                 _ingest(out, next_off)
                 if ckpt is not None:
@@ -419,8 +464,7 @@ def _run_wordcount_body(config: JobConfig, obs: Obs, mapper: Mapper,
         else:
             outputs = run_map_phase(
                 chunks, mapper, config.num_map_workers, config.max_retries,
-                pipeline_depth=obs.knob("pipeline_depth",
-                                        config.pipeline_depth), obs=obs,
+                pipeline_depth=depth, obs=obs,
             )
             for idx, out in outputs:
                 gidx = resume_k + idx
@@ -525,6 +569,8 @@ def _run_inverted_index_body(config: JobConfig, obs: Obs
 
     metrics = obs.registry
     mapper = make_inverted_index(config.tokenizer, config.use_native)
+    transport = solved_transport(config, obs)
+    push_mode = transport == "pipelined"
     if effective_num_shards(config) > 1:
         from map_oxidize_tpu.parallel.collect import ShardedCollectEngine
 
@@ -532,11 +578,13 @@ def _run_inverted_index_body(config: JobConfig, obs: Obs
             _log.info("collect_sort=%r applies to the single-chip engine "
                       "only; the sharded path sorts per shard on device",
                       config.collect_sort)
-        engine = ShardedCollectEngine(config, **collect_engine_kw(config))
+        engine = ShardedCollectEngine(config, transport=transport,
+                                      **collect_engine_kw(config))
     else:
         from map_oxidize_tpu.runtime.collect import CollectEngine
 
-        engine = CollectEngine(config, **collect_engine_kw(config))
+        engine = CollectEngine(config, transport=transport,
+                               **collect_engine_kw(config))
     engine.obs = obs
     # the active shuffle transport rides /status and the ledger entry
     metrics.set("shuffle/transport", engine.transport)
@@ -598,9 +646,13 @@ def _run_inverted_index_body(config: JobConfig, obs: Obs
                     yield mapper.map_docs(chunk, off - len(chunk)), off
             it = _host_iter()
         # prefetch: doc-chunk read+tokenize overlaps the collect feed
-        it = pipelined(it, obs.knob("pipeline_depth",
-                                    config.pipeline_depth), obs,
-                       name="map")
+        depth = obs.knob("pipeline_depth", config.pipeline_depth)
+        if push_mode:
+            depth = max(2, int(depth))
+        it = pipelined(it, depth, obs,
+                       name="push" if push_mode else "map",
+                       ratio_gauge=("pipeline/shuffle_overlap_ratio"
+                                    if push_mode else None))
         for i, (out, next_off) in enumerate(it):
             _ingest(out, next_off)
             if ckpt is not None:

@@ -64,7 +64,7 @@ class HostCollectReduceEngine:
 
     def __init__(self, config: JobConfig, reducer: Reducer,
                  value_shape: tuple = (), value_dtype=np.int32,
-                 max_rows: int = 1 << 28):
+                 max_rows: int = 1 << 28, transport: str | None = None):
         from map_oxidize_tpu.shuffle import make_transport, resolve_transport
 
         if tuple(value_shape) != ():
@@ -78,8 +78,10 @@ class HostCollectReduceEngine:
         self.max_rows = max_rows
         #: placement policy (map_oxidize_tpu.shuffle): hybrid = today's
         #: spill-past-the-cap, disk = buckets from the first row, hbm =
-        #: strictly resident (the cap raises)
-        self.transport = resolve_transport(config, max_rows)
+        #: strictly resident (the cap raises).  Callers that applied the
+        #: planner's knob (Obs.knob seam) pass the resolved name.
+        self.transport = (transport if transport is not None
+                          else resolve_transport(config, max_rows))
         self._transport = make_transport(self.transport)
         self._buckets_opened: set = set()
         self.rows_fed = 0
@@ -129,7 +131,9 @@ class HostCollectReduceEngine:
         action = self._transport.admit(
             self.rows_fed, self.max_rows,
             "host collect-reduce (HostCollectReduceEngine)")
-        if action != "resident":
+        if action in ("demote", "spill"):
+            # 'push' (pipelined, under the cap) stays resident: the
+            # eager-merge cadence is the driver's half of the seam
             self._begin_spill(demote=action == "demote")
 
     def flush(self) -> None:  # feed is already host-resident

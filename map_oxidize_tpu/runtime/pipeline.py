@@ -275,13 +275,19 @@ class BlockStager(ChunkPrefetcher):
 
 
 def pipelined(it: Iterable[T], depth: int, obs=None,
-              name: str = "pipeline") -> Iterable[T]:
+              name: str = "pipeline",
+              ratio_gauge: str | None = None) -> Iterable[T]:
     """Driver-facing wrapper: prefetch ``it`` with ``depth`` in-flight
     items, recording the overlap counters into ``obs`` when given.
 
     ``depth <= 1`` returns ``it`` unchanged — the serial baseline
     schedule, no thread, no counters — so ``--pipeline-depth 1`` is a
     true control arm, not a degenerate pipeline.
+
+    ``ratio_gauge`` names an EXTRA gauge fed the same live overlap ratio
+    — the push-shuffle drivers pass ``pipeline/shuffle_overlap_ratio``
+    so the shuffle-behind-map overlap is separable from ordinary map
+    prefetch in the ledger gate and the bench snapshots.
     """
     if depth <= 1:
         return it
@@ -291,6 +297,12 @@ def pipelined(it: Iterable[T], depth: int, obs=None,
     # old end-of-stream accounting
     pf = ChunkPrefetcher(it, depth - 1, name=name, obs=obs)
 
+    def _set_ratio(reg) -> None:
+        ratio = round(pf.overlap_ratio, 4)
+        reg.set("pipeline/overlap_ratio", ratio)
+        if ratio_gauge:
+            reg.set(ratio_gauge, ratio)
+
     def _run():
         try:
             for item in pf:
@@ -298,15 +310,13 @@ def pipelined(it: Iterable[T], depth: int, obs=None,
                     # live overlap gauge: the time-series recorder and
                     # /status read it MID-run; one locked gauge write
                     # per chunk is noise at chunk cadence
-                    obs.registry.set("pipeline/overlap_ratio",
-                                     round(pf.overlap_ratio, 4))
+                    _set_ratio(obs.registry)
                 yield item
         finally:
             if obs is not None and (pf.items or pf.produce_s):
                 reg = obs.registry
                 reg.set("pipeline/depth", depth)
-                reg.set("pipeline/overlap_ratio",
-                        round(pf.overlap_ratio, 4))
+                _set_ratio(reg)
                 obs.tracer.instant(
                     f"{name}/pipeline_done", items=pf.items,
                     produce_ms=round(pf.produce_s * 1e3, 3),

@@ -34,9 +34,9 @@ and is scored against the measured wall at finish.
 
 The planner never mutates the JobConfig (the ledger's config-hash
 identity must not depend on what the planner chose): solved values are
-applied through ``Obs.knob()`` (pipeline depth) and the dispatch
-resolver's own calibration-curve inputs (B), and advisory knobs record
-the value the engine will derive anyway.
+applied through ``Obs.knob()`` (pipeline depth, shuffle transport) and
+the dispatch resolver's own calibration-curve inputs (B), and advisory
+knobs record the value the engine will derive anyway.
 """
 
 from __future__ import annotations
@@ -70,6 +70,12 @@ FEED_WAIT_DEEPEN_PCT = 15.0
 #: curve-driven depth ceiling: past ~4 chunks of readahead the producer
 #: threads are already saturated and extra depth only buys memory
 MAX_PLANNED_DEPTH = 4
+#: exchange share of wall (percent) above which the measured curve says
+#: the shuffle barrier is worth hiding behind map — the plan then routes
+#: the shuffle_transport knob to 'pipelined' (resident routes only; a
+#: spill route means rows exceed the cap and placement, not cadence, is
+#: the bottleneck)
+EXCHANGE_PUSH_PCT = 10.0
 
 
 def solve_batch(floor_ms: float, compute_ms: float | None = None,
@@ -217,8 +223,14 @@ def build_plan(config, workload: str, calib_prior=None,
           "pinned" if "chunk_bytes" in pins else "default",
           {"n_chunks": shape["n_chunks"]} if shape["n_chunks"] else None)
 
-    # shuffle_transport — 'auto' already routes on measured-free shape
-    # (corpus vs cap); the plan records the route the engine will take
+    # shuffle_transport — curve-driven since the push transport landed
+    # (no longer advisory): the knob is APPLIED through Obs.knob at the
+    # driver/distributed engine sites, resolving through the same router
+    # the engines use.  A pin still wins.  With a measured curve, an
+    # exchange share above EXCHANGE_PUSH_PCT on a resident route is
+    # exactly the waste the critpath's map_shuffle_overlapped what-if
+    # prices — the plan routes to 'pipelined' to bank it.  Cold runs
+    # keep recording 'auto' as a default, never dressed as a prediction.
     if "shuffle_transport" in pins:
         _knob("shuffle_transport", config.shuffle_transport, "pinned",
               {"requested": config.shuffle_transport})
@@ -226,9 +238,22 @@ def build_plan(config, workload: str, calib_prior=None,
         from map_oxidize_tpu.shuffle.base import resolve_transport
 
         cap = int(getattr(config, "collect_max_rows", 0) or 0) or (1 << 27)
-        _knob("shuffle_transport", "auto", "default",
-              {"routes_to": resolve_transport(config, cap),
-               "est_rows": shape["est_rows"], "resident_cap": cap})
+        routed = resolve_transport(config, cap)
+        if wl_curve:
+            ex = wl_curve["buckets_ms_per_mb"].get("exchange", 0.0)
+            share = 100.0 * ex / max(wl_curve["wall_ms_per_mb"], 1e-9)
+            ev = {"exchange_share_pct": round(share, 2),
+                  "curve_runs": wl_curve["runs"],
+                  "routes_to": routed, "resident_cap": cap}
+            if share > EXCHANGE_PUSH_PCT and routed in ("hbm", "hybrid"):
+                ev["pushed_from"] = routed
+                _knob("shuffle_transport", "pipelined", "curve", ev)
+            else:
+                _knob("shuffle_transport", routed, "curve", ev)
+        else:
+            _knob("shuffle_transport", "auto", "default",
+                  {"routes_to": routed,
+                   "est_rows": shape["est_rows"], "resident_cap": cap})
 
     # sort_sample — advisory: the curve's host_sort share is the
     # evidence a future splitter-count rule would consume

@@ -11,7 +11,7 @@ demotes to disk, and the observability contract every placement must
 honor — so the driver picks the transport (``--shuffle-transport``)
 instead of each engine hard-coding one.
 
-Three concrete transports behind one small interface:
+Five concrete transports behind one small interface:
 
 * :class:`~map_oxidize_tpu.shuffle.hbm.HbmTransport` — strictly
   device/RAM-resident (today's ``all_to_all``/accumulator paths,
@@ -21,6 +21,14 @@ Three concrete transports behind one small interface:
   memory at any corpus size.
 * :class:`~map_oxidize_tpu.shuffle.hybrid.HybridTransport` — resident
   until the cap trips, then a one-way demotion to disk buckets mid-job.
+* :class:`~map_oxidize_tpu.shuffle.pipelined.PipelinedTransport` —
+  hybrid's placement with an eager push cadence: each fed block is
+  hash-partitioned and merged into its owner WHILE map still produces
+  (no terminal barrier), optionally pre-combined map-side.
+* :class:`~map_oxidize_tpu.shuffle.remote.RemoteTransport` — staged
+  from the first row like disk, but in a shared-filesystem object
+  layout (``moxt-shuffle-stage-v1`` manifests) a surviving peer can
+  finish the job from after a process dies mid-shuffle.
 
 ``auto`` routes on corpus size vs the cap (:func:`resolve_transport`).
 """
@@ -36,6 +44,12 @@ from map_oxidize_tpu.shuffle.base import (
 from map_oxidize_tpu.shuffle.disk import DiskPairStage, DiskTransport
 from map_oxidize_tpu.shuffle.hbm import HbmTransport
 from map_oxidize_tpu.shuffle.hybrid import HybridTransport
+from map_oxidize_tpu.shuffle.pipelined import (
+    PipelinedTransport,
+    combine_map_output,
+    record_push_combine,
+)
+from map_oxidize_tpu.shuffle.remote import RemoteStage, RemoteTransport
 
 __all__ = [
     "AUTO_BYTES_PER_ROW",
@@ -43,9 +57,14 @@ __all__ = [
     "DiskTransport",
     "HbmTransport",
     "HybridTransport",
+    "PipelinedTransport",
+    "RemoteStage",
+    "RemoteTransport",
     "ShuffleTransport",
     "TRANSPORTS",
+    "combine_map_output",
     "make_transport",
     "record_demotion",
+    "record_push_combine",
     "resolve_transport",
 ]

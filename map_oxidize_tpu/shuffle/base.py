@@ -1,12 +1,22 @@
 """Shuffle transport interface: partition -> exchange -> drain.
 
 A transport answers one question per fed block — *where do shuffled rows
-stage?* — through a tiny two-state machine:
+stage?* — through a tiny three-state machine:
 
-    RESIDENT --(trip: resident rows cross the cap)--> SPILLED
+    PUSHING --(trip)--> SPILLED <--(trip: resident rows cross the cap)-- RESIDENT
 
 ``hbm`` never leaves RESIDENT (the trip is a hard error), ``disk`` starts
 in SPILLED, ``hybrid`` makes the one-way demotion transition mid-job.
+``pipelined`` starts in PUSHING — every fed block is hash-partitioned
+and pushed to its owner while map is still producing (the ``"push"``
+verdict: resident placement plus an eager per-block merge, no terminal
+barrier), optionally sum-combining partial fold states per push window;
+at the cap it takes the same one-way demotion to SPILLED as hybrid.
+``remote`` starts in SPILLED like disk, but the stage is a SHARED
+filesystem object layout under a ``moxt-shuffle-stage-v1`` manifest
+(:mod:`map_oxidize_tpu.shuffle.remote`) so multi-host runs stop
+requiring all-resident peers: a job can finish from staged partitions
+after a process dies mid-shuffle.
 The engines own the mechanisms on each side of the seam — the jitted
 ``all_to_all`` exchange programs (:mod:`map_oxidize_tpu.parallel.shuffle`)
 for RESIDENT, the top-bits disk buckets (:mod:`map_oxidize_tpu.runtime.spill`)
@@ -43,7 +53,7 @@ import abc
 import os
 
 #: the ``--shuffle-transport`` vocabulary (config + CLI + serve ``--set``)
-TRANSPORTS = ("auto", "hbm", "disk", "hybrid")
+TRANSPORTS = ("auto", "hbm", "disk", "hybrid", "pipelined", "remote")
 
 #: auto-routing density assumption: one shuffled row per this many corpus
 #: bytes.  Deliberately conservative (short-token text emits a pair per
@@ -53,7 +63,7 @@ TRANSPORTS = ("auto", "hbm", "disk", "hybrid")
 AUTO_BYTES_PER_ROW = 16
 
 
-def resolve_transport(config, max_rows: int) -> str:
+def resolve_transport(config, max_rows: int, name: str | None = None) -> str:
     """Resolve ``config.shuffle_transport`` to a concrete transport name.
 
     ``auto`` routes on corpus size vs the resident-row cap: estimated
@@ -62,8 +72,14 @@ def resolve_transport(config, max_rows: int) -> str:
     drain and bound residency from row 0), anything else picks
     ``hybrid`` (resident speed, disk safety net) — today's engine
     behavior, now a named policy.  An unreadable input (serve jobs
-    validate paths later) falls back to ``hybrid``."""
-    name = getattr(config, "shuffle_transport", "auto")
+    validate paths later) falls back to ``hybrid``.
+
+    ``name`` overrides the config's spelling — the planner's
+    ``Obs.knob("shuffle_transport")`` seam resolves the PLANNED name
+    through the same router, so a curve-chosen ``pipelined`` and a
+    pinned one take an identical path."""
+    if name is None:
+        name = getattr(config, "shuffle_transport", "auto")
     if name != "auto":
         return name
     try:
@@ -80,6 +96,11 @@ class ShuffleTransport(abc.ABC):
 
     * ``"resident"`` — keep the block on the resident path (device
       buffers / host RAM staging).
+    * ``"push"`` — resident placement PLUS an eager per-block push: the
+      engine partitions and merges the block into its owner immediately
+      instead of accumulating toward a terminal barrier (the PUSHING
+      state; placement-wise engines treat it exactly like
+      ``"resident"``, the push cadence is the driver's half).
     * ``"spill"`` — stage the block in disk buckets.
     * ``"demote"`` — drain the resident state to disk buckets first
       (record it via :func:`record_demotion`), then spill this block and
@@ -114,10 +135,14 @@ def make_transport(name: str) -> ShuffleTransport:
     from map_oxidize_tpu.shuffle.disk import DiskTransport
     from map_oxidize_tpu.shuffle.hbm import HbmTransport
     from map_oxidize_tpu.shuffle.hybrid import HybridTransport
+    from map_oxidize_tpu.shuffle.pipelined import PipelinedTransport
+    from map_oxidize_tpu.shuffle.remote import RemoteTransport
 
     try:
         cls = {"hbm": HbmTransport, "disk": DiskTransport,
-               "hybrid": HybridTransport}[name]
+               "hybrid": HybridTransport,
+               "pipelined": PipelinedTransport,
+               "remote": RemoteTransport}[name]
     except KeyError:
         raise ValueError(
             f"unknown shuffle transport {name!r}; expected one of "

@@ -155,6 +155,159 @@ print(f"spilled shuffle OK: 2-proc completed past the cap "
       "parity exact, default path zero-spill")
 EOF
 
+echo "== push shuffle smoke =="
+# ISSUE-19: (a) a 2-process wordcount under --shuffle-transport pipelined
+# must match the barrier (hbm) transport's partition files byte for byte,
+# with nonzero push rounds and a nonzero pipeline/shuffle_overlap_ratio
+# on at least one process (chunks round-robin, so one side can hold fewer
+# rounds); the conservation audit is ON (default), so a clean exit IS the
+# audit's green verdict.  (b) a 2-process remote-staged job must complete
+# with clean-run parity after one process is SIGKILLed mid-shuffle,
+# finishing from the staged partitions via the .rec takeover.
+python - "$smoke" <<'EOF'
+import sys
+import numpy as np
+rng = np.random.default_rng(19)
+words = [f"tok{i:04d}".encode() for i in range(3000)]
+with open(f"{sys.argv[1]}/corpus_push.txt", "wb") as f:
+    for _ in range(100000):
+        f.write(b" ".join(words[int(i)]
+                          for i in rng.integers(0, 3000, 8)) + b"\n")
+EOF
+for transport in hbm pipelined; do
+    push_port=$(python - <<'EOF'
+import socket
+s = socket.socket(); s.bind(("127.0.0.1", 0))
+print(s.getsockname()[1]); s.close()
+EOF
+)
+    push_pids=()
+    for p in 0 1; do
+        JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=4 \
+            timeout -k 10 600 \
+            python -m map_oxidize_tpu wordcount "$smoke/corpus_push.txt" \
+            --output "$smoke/push_$transport.txt" --batch-size 2048 \
+            --chunk-mb 1 --push-combine off --quiet \
+            --shuffle-transport "$transport" \
+            --dist-coordinator "127.0.0.1:$push_port" --dist-processes 2 \
+            --dist-process-id "$p" \
+            --metrics-out "$smoke/push_${transport}_metrics.json" \
+            > /dev/null &
+        push_pids+=($!)
+    done
+    push_rc=0
+    for pid in "${push_pids[@]}"; do wait "$pid" || push_rc=$?; done
+    if [ "$push_rc" -ne 0 ]; then
+        echo "push shuffle smoke: a 2-proc $transport child failed" \
+             "(rc=$push_rc)"
+        exit "$push_rc"
+    fi
+done
+python - "$smoke" <<'EOF'
+import json, sys
+d = sys.argv[1]
+for i in range(2):
+    a = open(f"{d}/push_hbm.txt.part{i}of2", "rb").read()
+    b = open(f"{d}/push_pipelined.txt.part{i}of2", "rb").read()
+    assert a == b, f"pipelined partition {i} != barrier transport"
+rounds, ratios = 0, []
+for i in range(2):
+    m = json.load(open(f"{d}/push_pipelined_metrics.json.proc{i}"))
+    assert m["gauges"]["shuffle/transport"] == "pipelined", m["gauges"]
+    rounds += m["counters"].get("shuffle/push_rounds", 0)
+    assert m["counters"].get("pipeline/produce_ms", 0) > 0, \
+        f"process {i} never produced through the push pipeline"
+    ratios.append(m["gauges"].get("pipeline/shuffle_overlap_ratio", 0.0))
+assert rounds > 0, "no push rounds recorded"
+assert max(ratios) > 0.0, f"push pipeline never overlapped: {ratios}"
+print(f"push shuffle OK: pipelined == barrier byte-for-byte, "
+      f"{rounds} push rounds, overlap ratios {ratios}, audit green")
+EOF
+
+# (b) remote-staged SIGKILL recovery: process 1 kills itself (real
+# SIGKILL) after its second committed chunk; process 0 must claim the
+# dead peer's remainder and finish with clean-run parity
+cat > "$smoke/remote_child.py" <<'EOF'
+import json, os, signal, sys
+pid, corpus, outdir, die = (int(sys.argv[1]), sys.argv[2],
+                            sys.argv[3], int(sys.argv[4]))
+from map_oxidize_tpu.config import JobConfig
+from map_oxidize_tpu.parallel.distributed import run_distributed_job
+from map_oxidize_tpu.shuffle import remote as rmod
+if die and pid == 1:
+    orig = rmod.RemoteStage.append_chunk
+    n = [0]
+    def bomb(self, *a, **kw):
+        orig(self, *a, **kw)
+        n[0] += 1
+        if n[0] >= 2:
+            os.kill(os.getpid(), signal.SIGKILL)
+    rmod.RemoteStage.append_chunk = bomb
+cfg = JobConfig(input_path=corpus,
+                output_path=os.path.join(outdir, "out.txt"),
+                chunk_bytes=512, shuffle_transport="remote",
+                remote_stage_dir=os.path.join(outdir, "stage"),
+                remote_stage_timeout_s=10.0,
+                dist_num_processes=2, dist_process_id=pid,
+                metrics=False)
+r = run_distributed_job(cfg, "wordcount")
+json.dump({"counts": {str(k): v for k, v in r.counts.items()}},
+          open(os.path.join(outdir, f"counts{pid}.json"), "w"),
+          sort_keys=True)
+EOF
+python - "$smoke" <<'EOF'
+import sys
+lines = [b"pelican heron egret heron stork pelican crane\n",
+         b"egret stork stork crane pelican heron ibis\n"]
+with open(f"{sys.argv[1]}/corpus_remote.txt", "wb") as f:
+    for i in range(400):
+        f.write(lines[i % 2])
+EOF
+for sub in clean killed; do
+    mkdir -p "$smoke/remote_$sub"
+    die=0; [ "$sub" = killed ] && die=1
+    remote_pids=()
+    for p in 0 1; do
+        JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=1 \
+            PYTHONPATH="$PWD" timeout -k 10 420 \
+            python "$smoke/remote_child.py" "$p" \
+            "$smoke/corpus_remote.txt" "$smoke/remote_$sub" "$die" \
+            > /dev/null &
+        remote_pids+=($!)
+    done
+    remote_rcs=()
+    for pid in "${remote_pids[@]}"; do
+        rc=0; wait "$pid" || rc=$?
+        remote_rcs+=("$rc")
+    done
+    if [ "$sub" = clean ]; then
+        [ "${remote_rcs[0]}" -eq 0 ] && [ "${remote_rcs[1]}" -eq 0 ] || {
+            echo "remote clean run failed (rc=${remote_rcs[*]})"; exit 1; }
+    else
+        # child 1 dies by SIGKILL (137 via shell); child 0 must survive
+        [ "${remote_rcs[0]}" -eq 0 ] && [ "${remote_rcs[1]}" -eq 137 ] || {
+            echo "remote SIGKILL run: want rc 0/137," \
+                 "got ${remote_rcs[*]}"; exit 1; }
+    fi
+done
+python - "$smoke" <<'EOF'
+import json, os, sys
+d = sys.argv[1]
+clean = json.load(open(f"{d}/remote_clean/counts0.json"))
+survivor = json.load(open(f"{d}/remote_killed/counts0.json"))
+assert survivor == clean, "post-SIGKILL counts != clean run"
+for i in range(2):
+    a = open(f"{d}/remote_clean/out.txt.part{i}of2", "rb").read()
+    b = open(f"{d}/remote_killed/out.txt.part{i}of2", "rb").read()
+    assert a == b, f"post-SIGKILL partition {i} != clean run"
+stage = f"{d}/remote_killed/stage"
+assert os.path.exists(f"{stage}/claim.proc1"), "no takeover claim"
+rec = json.load(open(f"{stage}/manifest.proc1.rec.json"))
+assert rec["final"] and rec["staged_by"] == 0, rec
+print("remote SIGKILL OK: survivor claimed proc1, finished from the "
+      "staged partitions, byte parity with the clean run")
+EOF
+
 echo "== dataplane smoke =="
 # ISSUE-16 acceptance: a 2-process Gloo wordcount on a SKEWED corpus
 # must report per-partition rows-in/distinct-out, an order-independent

@@ -83,6 +83,32 @@ def test_config_and_cli_spelling(tmp_path):
         "shuffle_transport": "hybrid"}
 
 
+def test_push_transport_spelling(tmp_path):
+    """The pipelined/remote transports ride every existing spelling
+    surface: config validation, the CLI flag, and serve --set."""
+    for name in ("pipelined", "remote"):
+        JobConfig(shuffle_transport=name).validate()
+    with pytest.raises(ValueError, match="push_combine"):
+        JobConfig(push_combine="sideways").validate()
+    with pytest.raises(ValueError, match="remote_stage_timeout_s"):
+        JobConfig(remote_stage_timeout_s=0).validate()
+    from map_oxidize_tpu.cli import build_parser, config_from_args
+
+    path = tmp_path / "c.txt"
+    path.write_bytes(b"a b c\n")
+    args = build_parser().parse_args(
+        ["wordcount", str(path), "--shuffle-transport", "pipelined",
+         "--push-combine", "on", "--remote-stage-dir", str(tmp_path)])
+    cfg = config_from_args(args)
+    assert cfg.shuffle_transport == "pipelined"
+    assert cfg.push_combine == "on"
+    assert cfg.remote_stage_dir == str(tmp_path)
+    from map_oxidize_tpu.serve.client import coerce_overrides
+
+    assert coerce_overrides(["shuffle_transport=pipelined"]) == {
+        "shuffle_transport": "pipelined"}
+
+
 def test_transport_state_machines():
     from map_oxidize_tpu.shuffle import make_transport
 
