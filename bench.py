@@ -1329,9 +1329,13 @@ def _bench_workloads(run_job, JobConfig, probes=None) -> dict:
     # 2-process reduce under the push transport (nonzero shuffle
     # overlap + barrier-transport parity gated); shuffle/push_* ride
     # each entry's metrics_snapshot for the ledger
+    # reshard_selected_2proc (ISSUE-20): the exchange-collective A-B +
+    # store-driven auto selection, byte-parity enforced, with the
+    # decision and calib/* coverage gauges in metrics_snapshot
     for name, fn in (("wordcount_combined", _bench_wordcount_combined),
                      ("skewed_reduce_2proc_pipelined",
-                      _bench_2proc_pipelined)):
+                      _bench_2proc_pipelined),
+                     ("reshard_selected_2proc", _bench_reshard_selected)):
         _release_heap()
         try:
             entry = fn(slice_path)
@@ -1715,6 +1719,79 @@ def _bench_wordcount_combined(corpus: str) -> dict:
         "note": "2-process pipelined-push wordcount, map-side combiner "
                 "A-B: byte-identical output, comms bytes gated down",
         "metrics_snapshot": {k: v for k, v in snap_on.items()
+                             if k.startswith(keep)},
+    }
+
+
+def _bench_reshard_selected(corpus: str) -> dict:
+    """``reshard_selected_2proc``: the store-driven exchange-collective
+    selection loop on the 2-process Gloo mesh (ISSUE-20).  Two pinned
+    A-B runs — the monolithic ``all_to_all`` vs the decomposed
+    ``all_gather`` + dynamic-slice resharding — must produce
+    byte-identical output partitions while warming ONE calibration
+    store with job evidence for both curves; a third run under ``auto``
+    then reads those curves and its recorded decision (selection,
+    provenance, coverage gauges, measured exchange wall) rides
+    metrics_snapshot, where the ledger's selection-flip gate watches
+    it.  Thin evidence records the named default-fallback — either way
+    the decision fields must be present and the output identical."""
+    import shutil
+
+    calib_dir = os.path.join(CACHE_DIR, "reshard_calib")
+    shutil.rmtree(calib_dir, ignore_errors=True)
+    common = ["--batch-size", "4096", "--chunk-mb", "1",
+              "--calib-dir", calib_dir]
+    runs = {}
+    for method in ("all_to_all", "all_gather"):
+        out_p = os.path.join(CACHE_DIR, f"wc_resh_{method}.txt")
+        met_p = os.path.join(CACHE_DIR, f"wc_resh_{method}_metrics.json")
+        got = _launch_2proc_wordcount(
+            corpus, out_p, met_p,
+            ["--exchange-collective", method] + common)
+        if isinstance(got, str):
+            return {"error": f"exchange={method}: {got}"}
+        runs[method] = {"secs": got, "out": out_p,
+                        "snaps": _read_2proc_snaps(met_p)}
+    out_auto = os.path.join(CACHE_DIR, "wc_resh_auto.txt")
+    met_auto = os.path.join(CACHE_DIR, "wc_resh_auto_metrics.json")
+    # one A-B pair guarantees 2 sampled latencies per method (2
+    # processes x the always-sampled first exchange) whatever the
+    # corpus size — floor 2 makes the selection deterministic here
+    got = _launch_2proc_wordcount(
+        corpus, out_auto, met_auto, common + ["--calib-min-samples", "2"])
+    if isinstance(got, str):
+        return {"error": f"exchange=auto: {got}"}
+    snaps = _read_2proc_snaps(met_auto)
+    for i in range(2):
+        a = open(f"{runs['all_to_all']['out']}.part{i}of2", "rb").read()
+        b = open(f"{runs['all_gather']['out']}.part{i}of2", "rb").read()
+        c = open(f"{out_auto}.part{i}of2", "rb").read()
+        if not (a == b == c):
+            return {"error": "exchange-method output parity FAILED "
+                             f"(partition {i})"}
+    snap = snaps[0]
+    selected = snap.get("plan/exchange_collective")
+    if selected not in ("all_to_all", "all_gather"):
+        return {"error": f"auto run recorded no selection ({selected!r})"}
+    if snap.get("plan/exchange_collective_provenance") != "curve":
+        return {"error": "auto run did not select from the warmed store "
+                         f"(provenance={snap.get('plan/exchange_collective_provenance')!r})"}
+    keep = ("shuffle/", "comms/", "calib/", "plan/exchange",
+            "attrib/collective_wait")
+    return {
+        "all_to_all_s": round(runs["all_to_all"]["secs"], 3),
+        "all_gather_s": round(runs["all_gather"]["secs"], 3),
+        "auto_s": round(got, 3),
+        "selected": selected,
+        "selected_provenance": snap.get(
+            "plan/exchange_collective_provenance"),
+        "calib_coverage_pct": snap.get("calib/coverage_pct"),
+        "collective_wait_ms": snap.get("attrib/collective_wait_ms"),
+        "note": "2-process exchange-collective A-B + store-driven auto "
+                "selection: byte-identical partitions across all three "
+                "runs, decision + coverage + exchange wall gated via "
+                "metrics_snapshot",
+        "metrics_snapshot": {k: v for k, v in snap.items()
                              if k.startswith(keep)},
     }
 

@@ -300,6 +300,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "per-program dispatch/compute into "
                         "<dir>/calib.json (merged atomically across "
                         "runs; render with `obs calib`)")
+    p.add_argument("--exchange-collective",
+                   choices=["auto", "all_to_all", "all_gather"],
+                   default="auto",
+                   help="shuffle exchange wire program: auto (default) "
+                        "lets the planner pick from the calibration "
+                        "store's measured curves (monolithic all_to_all "
+                        "vs the decomposed all_gather+slice resharding; "
+                        "falls back to all_to_all with a named reason on "
+                        "a cold store); explicit values pin.  Outputs "
+                        "are byte-identical either way")
+    p.add_argument("--calib-min-samples", type=int, default=3,
+                   help="chooser evidence floor: sampled latencies "
+                        "required in the exact payload bucket before a "
+                        "store curve may steer the exchange collective")
     p.add_argument("--keep-intermediates", action="store_true")
     p.add_argument("-v", "--verbose", action="store_true")
     p.add_argument("-q", "--quiet", action="store_true")
@@ -359,6 +373,8 @@ def config_from_args(args: argparse.Namespace) -> JobConfig:
         remote_stage_dir=args.remote_stage_dir,
         remote_stage_timeout_s=args.remote_stage_timeout,
         plan=args.plan,
+        exchange_collective=args.exchange_collective,
+        calib_min_samples=args.calib_min_samples,
         hll_precision=args.hll_precision,
         kmeans_k=args.kmeans_k,
         kmeans_iters=args.kmeans_iters,

@@ -299,6 +299,17 @@ class JobConfig:
     #: recorded as ``pinned``.  'off' skips planning entirely (no plan
     #: doc, no ``plan/*`` gauges beyond the dispatch aliases)
     plan: str = "auto"
+    #: the shuffle exchange's wire program: 'auto' lets the planner's
+    #: chooser (parallel.shuffle.choose_collective) pick from the
+    #: calibration store's measured curves — monolithic 'all_to_all' vs
+    #: the decomposed 'all_gather' + dynamic-slice resharding
+    #: (arXiv:2112.01075) — falling back to all_to_all with a named
+    #: reason on a cold/out-of-range/thin store.  Explicit values pin.
+    exchange_collective: str = "auto"
+    #: chooser evidence floor: sampled latencies required in the exact
+    #: payload bucket before a store curve may steer the exchange (below
+    #: it the decision falls back with reason 'below min-samples floor')
+    calib_min_samples: int = 3
 
     def validate(self) -> "JobConfig":
         if self.plan not in ("auto", "off"):
@@ -357,6 +368,19 @@ class JobConfig:
             raise ValueError(
                 f"push_combine must be auto|on|off, "
                 f"got {self.push_combine!r}")
+        # literal mirror of parallel.shuffle.EXCHANGE_COLLECTIVES — that
+        # module imports jax at top level, and validate() must stay
+        # importable on the jax-free CLI paths (a parity test pins the
+        # two tuples)
+        if self.exchange_collective not in ("auto", "all_to_all",
+                                            "all_gather"):
+            raise ValueError(
+                "exchange_collective must be one of "
+                "auto|all_to_all|all_gather, "
+                f"got {self.exchange_collective!r}")
+        if self.calib_min_samples < 1:
+            raise ValueError(
+                "calib_min_samples must be >= 1 sampled latencies")
         if self.remote_stage_timeout_s <= 0:
             raise ValueError(
                 "remote_stage_timeout_s must be positive seconds")

@@ -312,6 +312,12 @@ class Obs:
                 # counts the history already on disk
                 obs.calib_prior = _calib.CalibStore.load(path)
                 obs.calib = _calib.CalibStore(path=path)
+                # cold-store visibility: 0 runs = a restarted server
+                # with an empty store (the fleet-calib-cold SLO rule
+                # and the fleet rollup read this)
+                obs.registry.set(
+                    "calib/store_runs",
+                    obs.calib_prior.doc.get("runs", 0))
             except _calib.CalibMismatch as e:
                 # refusal is the contract: stale/torn evidence must not
                 # merge — the run proceeds uncalibrated, loudly
@@ -491,7 +497,8 @@ class Obs:
         try:
             ident = _calib.run_identity(self.n_processes)
             touched = self.calib.accumulate_run(
-                ident, self.registry.comms_table(), xprof_report)
+                ident, self.registry.comms_table(), xprof_report,
+                source="job")
             if workload and workload != "serve":
                 touched += self.calib.accumulate_workload(
                     ident, workload, corpus_bytes, attrib_doc)

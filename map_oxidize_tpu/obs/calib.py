@@ -56,11 +56,49 @@ CALIB_SCHEMA = "moxt-calib-v1"
 CALIB_VERSION = 1
 CALIB_FILE = "calib.json"
 
-#: identity fields every row carries (and its key encodes)
+#: identity fields every row carries (and its key encodes).  ``source``
+#: is evidence provenance — ``"job"`` rows accumulated as a side effect
+#: of real runs, ``"probe"`` rows written by the deterministic
+#: microbenchmark harness (:mod:`map_oxidize_tpu.obs.probe`) — kept IN
+#: the identity so the two never merge into one row (never
+#: double-trusted), while the read-side curves pool them explicitly.
 _COMM_IDENTITY = ("platform", "device_count", "topology", "collective",
-                  "program", "shape_bucket")
+                  "program", "shape_bucket", "source")
 _PROG_IDENTITY = ("platform", "device_count", "topology", "program")
 _WORKLOAD_IDENTITY = ("platform", "device_count", "topology", "workload")
+
+#: legal evidence provenance tags (trailing ``_COMM_IDENTITY`` field)
+_SOURCES = ("job", "probe")
+
+#: ``obs diff --gate``: coverage dropping more than this many points
+#: against the baseline entry flags (the chooser went from informed to
+#: guessing — gate before the guess costs a mispredicted job)
+CALIB_COVERAGE_GATE_POINTS = 10.0
+
+#: selection floor: below this many sampled latencies in the exact
+#: bucket the chooser refuses to trust a curve (named reason, default
+#: kept) — 1–2 samples is an anecdote, not evidence
+CALIB_MIN_SAMPLES = 3
+
+#: jax-free mirror of ``parallel.shuffle.EXCHANGE_COLLECTIVES`` (this
+#: module must stay importable on jax-free CLI paths; a parity test
+#: pins the two tuples)
+EXCHANGE_COLLECTIVE_NAMES = ("all_to_all", "all_gather")
+
+
+def exchange_shape(num_shards: int, batch_size: int,
+                   collect: bool = False) -> tuple:
+    """The ``(bucket_cap, value_row_bytes)`` the engines will derive for
+    a job of this shape — the jax-free mirror of the fold engine's cap
+    derivation (``parallel.shuffle.build_sharded_ops``) and the
+    pair-collect engines' full-batch cap, shared by the planner's
+    chooser call and ``obs calib coverage`` so both price the exchange
+    at the same payload bucket the run will record."""
+    S = max(int(num_shards), 1)
+    bps = max(1, int(batch_size) // S)
+    if collect:
+        return bps, 8
+    return min(bps, 2 * (-(-bps // S)) + 16), 4
 
 
 class CalibMismatch(ValueError):
@@ -103,9 +141,30 @@ def run_identity(n_processes: int = 1) -> dict:
 
 
 def _comm_key(ident: dict, collective: str, program: str,
-              bucket: str) -> str:
+              bucket: str, source: str = "job") -> str:
     return "|".join([ident["platform"], str(ident["device_count"]),
-                     ident["topology"], collective, program, bucket])
+                     ident["topology"], collective, program, bucket,
+                     source])
+
+
+def _normalize_legacy_comms(doc: dict) -> None:
+    """Rewrite pre-``source`` comms rows (6-part keys) in place to the
+    7-part form, tagging them ``source="job"`` — every legacy row WAS
+    organic job evidence.  Runs before :func:`validate_doc` so a store
+    written by an older build still loads/merges instead of refusing."""
+    if not isinstance(doc, dict):
+        return
+    comms = doc.get("comms")
+    if not isinstance(comms, dict):
+        return
+    legacy = [k for k in comms
+              if isinstance(k, str)
+              and len(k.split("|")) == len(_COMM_IDENTITY) - 1]
+    for key in legacy:
+        row = comms.pop(key)
+        if isinstance(row, dict):
+            row.setdefault("source", "job")
+        comms[key + "|job"] = row
 
 
 def _prog_key(ident: dict, program: str) -> str:
@@ -150,6 +209,7 @@ class CalibStore:
         except (OSError, ValueError) as e:
             raise CalibMismatch(f"unreadable calibration store {path!r}: "
                                 f"{e}") from e
+        _normalize_legacy_comms(doc)
         validate_doc(doc, path)
         store.doc = doc
         return store
@@ -157,10 +217,15 @@ class CalibStore:
     # --- accumulation (one run's measurements) ----------------------------
 
     def accumulate_run(self, ident: dict, comms_rows: list | None,
-                       xprof_report: dict | None) -> int:
+                       xprof_report: dict | None,
+                       source: str = "job") -> int:
         """Fold one finished run's comms table + xprof program rows into
-        this store under ``ident``.  Returns the number of rows
-        touched."""
+        this store under ``ident``, tagged with evidence ``source``
+        (``"job"`` for organic runs, ``"probe"`` for the microbenchmark
+        harness).  Returns the number of rows touched."""
+        if source not in _SOURCES:
+            raise ValueError(f"source must be one of {_SOURCES}, "
+                             f"got {source!r}")
         touched = 0
         for r in comms_rows or []:
             calls = int(r.get("count") or 0)
@@ -168,13 +233,14 @@ class CalibStore:
             if calls <= 0:
                 continue
             bucket = shape_bucket(nbytes / calls)
-            key = _comm_key(ident, r["collective"], r["program"], bucket)
+            key = _comm_key(ident, r["collective"], r["program"], bucket,
+                            source)
             row = self.doc["comms"].get(key)
             if row is None:
                 row = self.doc["comms"][key] = dict(
                     ident, collective=r["collective"],
                     program=r["program"], shape_bucket=bucket,
-                    calls=0, bytes=0.0, latency_ms=0.0,
+                    source=source, calls=0, bytes=0.0, latency_ms=0.0,
                     latency_samples=0, runs=0)
             lat = r.get("latency_ms") or {}
             samples = int(lat.get("count") or 0)
@@ -243,7 +309,9 @@ class CalibStore:
     # --- merge / persist --------------------------------------------------
 
     def merge_from(self, other: dict) -> None:
-        """Fold another store DOCUMENT into this one (validated first)."""
+        """Fold another store DOCUMENT into this one (legacy comms keys
+        normalized to the ``source``-tagged form, then validated)."""
+        _normalize_legacy_comms(other)
         validate_doc(other)
         for section in ("comms", "programs", "workloads"):
             for key, row in (other.get(section) or {}).items():
@@ -477,6 +545,146 @@ def interpolate_latency_ms(store: "CalibStore | None", ident: dict,
     return pts[-1][1]  # pragma: no cover - unreachable past the clamp
 
 
+# --- the coverage plane (needs vs has) --------------------------------------
+
+
+def bucket_index(label: str) -> int | None:
+    """A shape-bucket label's power-of-two exponent (``"64KB"`` → 16),
+    the x-axis the coverage distance is measured on.  None for
+    unparsable or zero buckets."""
+    if not isinstance(label, str) or not label:
+        return None
+    for suffix, scale in (("TB", 1 << 40), ("GB", 1 << 30),
+                          ("MB", 1 << 20), ("KB", 1 << 10), ("B", 1)):
+        if label.endswith(suffix):
+            try:
+                n = int(label[:-len(suffix)]) * scale
+            except ValueError:
+                return None
+            return n.bit_length() - 1 if n > 0 else None
+    return None
+
+
+def collective_evidence(store: "CalibStore | None", ident: dict,
+                        collective: str, bucket: str,
+                        program: str | None = None) -> dict:
+    """What the store KNOWS about one (collective, bucket) cell under
+    this identity: sampled-latency counts in the exact bucket (total and
+    split by evidence ``source`` — probe and job rows pool for density
+    but stay attributable), plus ``bucket_distance`` — how many pow2
+    steps the nearest sampled bucket is from the needed one (0 = exact
+    hit; None = no sampled curve for this collective at all, i.e. a
+    cold cell where even extrapolation has nothing to extrapolate
+    from)."""
+    want = bucket_index(bucket)
+    samples = 0
+    by_source: dict[str, int] = {}
+    sampled: dict[str, int] = {}
+    for row in ((store.doc.get("comms") or {}).values()
+                if store is not None else ()):
+        if (row.get("platform") != ident["platform"]
+                or str(row.get("device_count")) != str(
+                    ident["device_count"])
+                or row.get("topology") != ident["topology"]
+                or row.get("collective") != collective):
+            continue
+        if program is not None and row.get("program") != program:
+            continue
+        s = int(row.get("latency_samples") or 0)
+        if s <= 0:
+            continue
+        b = row.get("shape_bucket")
+        sampled[b] = sampled.get(b, 0) + s
+        if b == bucket:
+            samples += s
+            src = row.get("source", "job")
+            by_source[src] = by_source.get(src, 0) + s
+    distance: int | None = None
+    if want is not None:
+        idxs = [i for i in (bucket_index(b) for b in sampled)
+                if i is not None]
+        if idxs:
+            distance = min(abs(want - i) for i in idxs)
+    return {
+        "bucket": bucket, "samples": samples, "by_source": by_source,
+        "bucket_distance": distance,
+        "sampled_buckets": sorted(sampled, key=lambda b:
+                                  bucket_index(b) or 0),
+    }
+
+
+def coverage_report(store: "CalibStore | None", ident: dict,
+                    needed_cells: list[dict],
+                    min_samples: int = CALIB_MIN_SAMPLES) -> dict:
+    """Needs-vs-has over the planner's required (collective, program,
+    bucket) cells: a cell is COVERED when the store holds at least
+    ``min_samples`` sampled latencies in the exact bucket.
+    ``coverage_pct`` is the covered fraction; ``extrapolation_bucket_
+    distance`` the worst pow2-step gap the chooser would have to
+    extrapolate across (cells with no curve at all are uncovered but
+    excluded from the distance — there is nothing to extrapolate
+    from)."""
+    cells = []
+    covered = 0
+    distances = []
+    for need in needed_cells:
+        ev = collective_evidence(store, ident, need["collective"],
+                                 need["bucket"],
+                                 program=need.get("program"))
+        ok = (ev["samples"] >= min_samples
+              and ev["bucket_distance"] == 0)
+        covered += int(ok)
+        if ev["bucket_distance"] is not None:
+            distances.append(ev["bucket_distance"])
+        cells.append({
+            "collective": need["collective"],
+            "program": need.get("program"),
+            "bucket": need["bucket"], "samples": ev["samples"],
+            "by_source": ev["by_source"],
+            "bucket_distance": ev["bucket_distance"], "covered": ok,
+        })
+    needed = len(cells)
+    return {
+        "schema": "moxt-calib-coverage-v1",
+        "identity": dict(ident), "min_samples": int(min_samples),
+        "needed": needed, "covered": covered,
+        "coverage_pct": round(100.0 * covered / needed, 1) if needed
+        else 100.0,
+        "extrapolation_bucket_distance": max(distances) if distances
+        else 0,
+        "cells": cells,
+    }
+
+
+def render_coverage(report: dict) -> str:
+    """Human-readable needs-vs-has table (`obs calib coverage`)."""
+    ident = report.get("identity") or {}
+    lines = [
+        f"calibration coverage: {report['covered']}/{report['needed']} "
+        f"cells covered ({report['coverage_pct']}%) under "
+        f"{ident.get('platform')}/{ident.get('topology')} "
+        f"(min {report['min_samples']} samples/cell); worst "
+        f"extrapolation distance "
+        f"{report['extrapolation_bucket_distance']} bucket(s)",
+        f"  {'collective':<11} {'program':<26} {'bucket':>7} "
+        f"{'samples':>8} {'dist':>5}  status",
+    ]
+    for c in report.get("cells") or []:
+        srcs = ",".join(f"{k}:{v}" for k, v in
+                        sorted((c.get("by_source") or {}).items()))
+        dist = c["bucket_distance"]
+        status = ("covered" if c["covered"] else
+                  "no curve" if dist is None else
+                  f"extrapolated ({dist} away)" if dist else
+                  "thin evidence")
+        lines.append(
+            f"  {c['collective']:<11} {c.get('program') or '*':<26} "
+            f"{c['bucket']:>7} {c['samples']:>8} "
+            f"{'-' if dist is None else dist:>5}  {status}"
+            + (f" [{srcs}]" if srcs else ""))
+    return "\n".join(lines)
+
+
 # --- rendering (the `obs calib` table) -------------------------------------
 
 
@@ -493,18 +701,30 @@ def render(store: CalibStore) -> str:
                 if doc.get("updated_unix_s") else "")]
     comms = store.bandwidth_table()
     if comms:
-        lines.append("collective bandwidth (per shape bucket):")
-        lines.append(f"  {'identity':<12} {'collective':<11} "
-                     f"{'program':<24} {'bucket':>7} {'calls':>7} "
-                     f"{'bytes':>9} {'lat ms':>8} {'GB/s':>7}")
+        lines.append("collective bandwidth (per shape bucket; rows with "
+                     f"< {CALIB_MIN_SAMPLES} samples marked 'thin' — "
+                     "below the selection floor):")
+        by_source: dict[str, list] = {}
         for r in comms:
-            ident = f"{r['platform']}/{r['topology']}"
-            lines.append(
-                f"  {ident:<12} {r['collective']:<11} {r['program']:<24} "
-                f"{r['shape_bucket']:>7} {r['calls']:>7} "
-                f"{_fmt_bytes(r['bytes']):>9} "
-                f"{r.get('mean_latency_ms', '-'):>8} "
-                f"{r.get('gbytes_per_s', '-'):>7}")
+            by_source.setdefault(r.get("source", "job"), []).append(r)
+        for src in sorted(by_source):
+            lines.append(f" source={src}:")
+            lines.append(f"  {'identity':<12} {'collective':<11} "
+                         f"{'program':<24} {'bucket':>7} {'calls':>7} "
+                         f"{'bytes':>9} {'smpl':>5} {'lat ms':>8} "
+                         f"{'GB/s':>7}")
+            for r in by_source[src]:
+                ident = f"{r['platform']}/{r['topology']}"
+                samples = int(r.get("latency_samples") or 0)
+                thin = ("  thin" if 0 < samples < CALIB_MIN_SAMPLES
+                        else "")
+                lines.append(
+                    f"  {ident:<12} {r['collective']:<11} "
+                    f"{r['program']:<24} "
+                    f"{r['shape_bucket']:>7} {r['calls']:>7} "
+                    f"{_fmt_bytes(r['bytes']):>9} {samples:>5} "
+                    f"{r.get('mean_latency_ms', '-'):>8} "
+                    f"{r.get('gbytes_per_s', '-'):>7}{thin}")
     else:
         lines.append("no collective rows yet (runs with a multi-shard "
                      "mesh or multi-process exchange populate them)")

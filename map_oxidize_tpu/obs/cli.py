@@ -55,10 +55,16 @@ Twelve subcommands, all pure host-side work (no jax, no backend init):
 * ``obs flame`` — renders a deep-profile capture's host sampling
   stacks (collapsed-stack format): hottest stacks and frames, joined
   against the attribution buckets.
-* ``obs calib`` — renders the persistent calibration store
-  (``--calib-dir``): per-collective bandwidth curves keyed (platform,
-  devices, topology, collective, program, shape-bucket) plus the
-  per-program dispatch/compute table accumulated across runs.
+* ``obs calib`` — the calibration-store tools.  ``show`` (also the
+  bare legacy form) renders the store: per-collective bandwidth curves
+  keyed (platform, devices, topology, collective, program,
+  shape-bucket, source) plus the per-program dispatch/compute table
+  accumulated across runs.  ``probe`` fills the curves with the
+  deterministic microbenchmark harness
+  (:mod:`map_oxidize_tpu.obs.probe`) — the ONE obs subcommand that
+  initializes jax.  ``coverage`` reports needs-vs-has for a job shape:
+  which (collective, bucket) cells the exchange chooser would consult
+  and whether the store can answer them.
 * ``obs fleet`` — the fleet observatory
   (:mod:`map_oxidize_tpu.obs.fleet`): a collector daemon polling any
   number of obs endpoints (``--targets``, a port file, resident-server
@@ -281,15 +287,72 @@ def build_obs_parser() -> argparse.ArgumentParser:
                     help="stacks/frames to list (default 15)")
 
     cb = sub.add_parser(
-        "calib", help="render the persistent calibration store "
-                      "(--calib-dir): per-collective bandwidth curves "
-                      "keyed (platform, devices, topology, collective, "
-                      "program, shape-bucket) plus per-program dispatch/"
-                      "compute figures accumulated across runs")
-    cb.add_argument("store", help="the --calib-dir directory (or its "
-                                  "calib.json)")
-    cb.add_argument("--json", action="store_true",
-                    help="emit the raw store document")
+        "calib", help="the calibration store tools: 'show' renders the "
+                      "per-collective bandwidth curves, 'probe' fills "
+                      "them with deterministic microbenchmarks (source: "
+                      "probe), 'coverage' reports needs-vs-has for a "
+                      "job shape (bare 'obs calib <store>' still shows)")
+    cbs = cb.add_subparsers(dest="calib_cmd", required=True)
+    cbw = cbs.add_parser(
+        "show", help="render the store: per-collective bandwidth "
+                     "curves keyed (platform, devices, topology, "
+                     "collective, program, shape-bucket, source) plus "
+                     "per-program dispatch/compute figures")
+    cbw.add_argument("store", help="the --calib-dir directory (or its "
+                                   "calib.json)")
+    cbw.add_argument("--json", action="store_true",
+                     help="emit the raw store document")
+    cbp = cbs.add_parser(
+        "probe", help="deterministic collective microbenchmarks: sweep "
+                      "the framework's exchange/psum/top-k programs "
+                      "across pow2 payload buckets on the current mesh "
+                      "and merge the rows in with source=probe (the ONE "
+                      "obs subcommand that initializes jax)")
+    cbp.add_argument("store", help="the --calib-dir directory to merge "
+                                   "into (created if missing)")
+    cbp.add_argument("--num-shards", type=int, default=8,
+                     help="mesh width; on a CPU-only host this many "
+                          "virtual devices are forced (default 8)")
+    cbp.add_argument("--buckets", nargs="*", default=None,
+                     metavar="BUCKET",
+                     help="payload buckets to sweep (pow2 labels, e.g. "
+                          "64KB 1MB; default 16KB..4MB)")
+    cbp.add_argument("--reps", type=int, default=None,
+                     help="timed repetitions per cell (default 5 — "
+                          "above the chooser's min-samples floor)")
+    cbp.add_argument("--backend", default="auto",
+                     help="device pool to probe ('cpu'/'tpu'; default "
+                          "auto)")
+    cbp.add_argument("--json", action="store_true",
+                     help="emit the probe summary document")
+    cbc = cbs.add_parser(
+        "coverage", help="needs-vs-has over the exchange chooser's "
+                         "cells for a job shape: which (collective, "
+                         "bucket) curves the planner would consult, "
+                         "and whether the store can answer")
+    cbc.add_argument("store", help="the --calib-dir directory (or its "
+                                   "calib.json)")
+    cbc.add_argument("--num-shards", type=int, default=8,
+                     help="job mesh width (default 8)")
+    cbc.add_argument("--batch-size", type=int, default=None,
+                     help="job batch size (default: JobConfig default)")
+    cbc.add_argument("--collect", action="store_true",
+                     help="price the pair-collect engines' exchange "
+                          "shape instead of the fold engine's")
+    cbc.add_argument("--min-samples", type=int, default=None,
+                     help="selection floor (default: the chooser's "
+                          "CALIB_MIN_SAMPLES)")
+    cbc.add_argument("--platform", default=None,
+                     help="identity platform (default: the store's "
+                          "sole identity, else required)")
+    cbc.add_argument("--topology", default=None,
+                     help="identity topology, e.g. 1x8 (default: the "
+                          "store's sole identity)")
+    cbc.add_argument("--device-count", type=int, default=None,
+                     help="identity device count (default: the store's "
+                          "sole identity)")
+    cbc.add_argument("--json", action="store_true",
+                     help="emit the coverage report document")
 
     fle = sub.add_parser(
         "fleet", help="fleet observatory: poll N obs endpoints, merge "
@@ -364,6 +427,12 @@ def build_obs_parser() -> argparse.ArgumentParser:
 
 
 def obs_main(argv: list[str]) -> int:
+    # back-compat: the pre-subcommand form `obs calib <store> [--json]`
+    # keeps working — insert the implicit 'show'
+    if (argv and argv[0] == "calib"
+            and (len(argv) == 1
+                 or argv[1] not in ("show", "probe", "coverage"))):
+        argv = [argv[0], "show", *argv[1:]]
     args = build_obs_parser().parse_args(argv)
     if args.cmd == "merge":
         return _merge(args)
@@ -676,6 +745,10 @@ def _flame(args) -> int:
 
 
 def _calib(args) -> int:
+    if args.calib_cmd == "probe":
+        return _calib_probe(args)
+    if args.calib_cmd == "coverage":
+        return _calib_coverage(args)
     import json
 
     from map_oxidize_tpu.obs.calib import CalibMismatch, CalibStore, render
@@ -694,6 +767,97 @@ def _calib(args) -> int:
         return 0
     print(render(store))
     return 0
+
+
+def _calib_probe(args) -> int:
+    import json
+
+    # the ONE obs subcommand that needs a backend: force the virtual CPU
+    # pool BEFORE jax initializes when the host has fewer real devices
+    flags = os.environ.get("XLA_FLAGS", "")
+    if (args.num_shards > 0
+            and "xla_force_host_platform_device_count" not in flags):
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count="
+            f"{args.num_shards}").strip()
+    from map_oxidize_tpu.obs import probe as _probe
+    from map_oxidize_tpu.obs.calib import CalibMismatch
+
+    kw = {}
+    if args.buckets:
+        kw["buckets"] = tuple(args.buckets)
+    if args.reps:
+        kw["reps"] = int(args.reps)
+    try:
+        summary = _probe.run_probe(args.store,
+                                   num_shards=args.num_shards,
+                                   backend=args.backend, **kw)
+    except CalibMismatch as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(summary, indent=1, sort_keys=True))
+        return 0
+    print(_probe.render_probe(summary))
+    return 0
+
+
+def _calib_coverage(args) -> int:
+    import json
+
+    from map_oxidize_tpu.obs import calib as _calib_mod
+
+    try:
+        store = _calib_mod.CalibStore.load(args.store)
+    except _calib_mod.CalibMismatch as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    # identity: explicit flags win; otherwise the store's sole identity
+    idents = {(r["platform"], str(r["device_count"]), r["topology"])
+              for r in (store.doc.get("comms") or {}).values()}
+    if args.platform and args.topology and args.device_count is not None:
+        ident = {"platform": args.platform,
+                 "device_count": args.device_count,
+                 "topology": args.topology}
+    elif len(idents) == 1:
+        p, dc, topo = next(iter(idents))
+        ident = {"platform": p, "device_count": int(dc),
+                 "topology": topo}
+    else:
+        print("error: store holds "
+              f"{len(idents)} identities; name one with --platform "
+              "--device-count --topology", file=sys.stderr)
+        return 2
+    if args.batch_size is None:
+        from map_oxidize_tpu.config import JobConfig
+
+        batch = dataclasses_field_default(JobConfig, "batch_size")
+    else:
+        batch = args.batch_size
+    cap, row_bytes = _calib_mod.exchange_shape(args.num_shards, batch,
+                                               collect=args.collect)
+    payload = (args.num_shards * args.num_shards * cap * (8 + row_bytes))
+    bucket = _calib_mod.shape_bucket(payload)
+    cells = [{"collective": c, "bucket": bucket}
+             for c in _calib_mod.EXCHANGE_COLLECTIVE_NAMES]
+    report = _calib_mod.coverage_report(
+        store, ident, cells,
+        min_samples=args.min_samples or _calib_mod.CALIB_MIN_SAMPLES)
+    if args.json:
+        print(json.dumps(report, indent=1, sort_keys=True))
+        return 0
+    print(_calib_mod.render_coverage(report))
+    return 0
+
+
+def dataclasses_field_default(cls, name: str):
+    """A dataclass field's default value (jax-free JobConfig peek)."""
+    import dataclasses
+
+    for f in dataclasses.fields(cls):
+        if f.name == name:
+            return f.default
+    raise AttributeError(name)
 
 
 def resolve_metrics_path(path: str) -> str:

@@ -124,6 +124,19 @@ FLEET_RULES: tuple[dict, ...] = (
      "scope": "fleet", "severity": "warning",
      "description": "malformed or version-mismatched payloads refused "
                     "at scrape (never merged into the fleet model)"},
+    # cold calibration store: a target publishing calib_store_runs == 0
+    # is a restarted server with an empty (or wiped) store — its first
+    # jobs will run the hard-coded collective defaults.  Info severity:
+    # visibility BEFORE the first mispredicted job, not an emergency
+    # (the gauge only exists where a target runs with --calib-dir, so
+    # uncalibrated fleets skip the rule by construction)
+    {"name": "fleet-calib-cold",
+     "metric": "fleet/target/*/calib_store_runs",
+     "kind": "value", "op": "<=", "threshold": 0, "scope": "fleet",
+     "severity": "info",
+     "description": "a target's calibration store holds zero merged "
+                    "runs (collective chooser will fall back to "
+                    "defaults)"},
 )
 
 
@@ -759,6 +772,17 @@ class FleetCollector:
             # key-skew rollup: only while the target publishes a
             # data-plane section (same presence contract as hbm_frac)
             m["imbalance_factor"] = round(float(imb), 4)
+        # calibration rollup: store warmth + chooser coverage, only
+        # while the target publishes a calib section (same presence
+        # contract as hbm_frac — uncalibrated targets have no gauges,
+        # so the fleet-calib-cold rule can't false-fire on them)
+        cal = (t.status or {}).get("calib") or {}
+        runs = cal.get("store_runs")
+        if isinstance(runs, (int, float)):
+            m["calib_store_runs"] = float(runs)
+        cov = cal.get("coverage_pct")
+        if isinstance(cov, (int, float)):
+            m["calib_coverage_pct"] = round(float(cov), 1)
         return m
 
     def _publish_gauges(self, now: float) -> None:
@@ -769,7 +793,9 @@ class FleetCollector:
         hbm_max = imb_max = 0.0
         n_up = n_stale = n_active = 0
         for label, (t, m) in rows.items():
-            for name in _TARGET_GAUGES + ("hbm_frac", "imbalance_factor"):
+            for name in _TARGET_GAUGES + ("hbm_frac", "imbalance_factor",
+                                          "calib_store_runs",
+                                          "calib_coverage_pct"):
                 if name in m:
                     self.registry.set(f"fleet/target/{label}/{name}",
                                       m[name])
@@ -844,6 +870,10 @@ class FleetCollector:
                 row["hbm_frac"] = m["hbm_frac"]
             if "imbalance_factor" in m:
                 row["imbalance_factor"] = m["imbalance_factor"]
+            if "calib_store_runs" in m:
+                row["calib_store_runs"] = m["calib_store_runs"]
+            if "calib_coverage_pct" in m:
+                row["calib_coverage_pct"] = m["calib_coverage_pct"]
             if t.last_error:
                 row["last_error"] = t.last_error
             rows.append(row)
@@ -926,7 +956,8 @@ class FleetCollector:
             rows = {t.label: self._target_metrics(t, now)
                     for t in self.targets.values() if not t.departed}
         lines: list[str] = []
-        for name in _TARGET_GAUGES + ("hbm_frac",):
+        for name in _TARGET_GAUGES + ("hbm_frac", "calib_store_runs",
+                                      "calib_coverage_pct"):
             fam = sanitize_metric_name(f"fleet_target_{name}")
             typed = False
             for label in sorted(rows):

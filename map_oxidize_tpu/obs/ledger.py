@@ -40,7 +40,7 @@ _NON_IDENTITY_FIELDS = frozenset({
     "hbm_sample_s", "stall_warn_factor",
     "obs_port", "obs_sample_s", "obs_spool",
     "slo_rules", "incident_dir", "data_audit",
-    "calib_dir", "profile_dir", "host_sample_hz",
+    "calib_dir", "profile_dir", "host_sample_hz", "calib_min_samples",
     "dist_coordinator", "dist_process_id",
 })
 
@@ -234,13 +234,38 @@ def diff_entries(a: dict, b: dict, threshold_pct: float = 10.0,
     for name in sorted((set(ma) | set(mb)) - skip):
         va, vb = ma.get(name), mb.get(name)
         if not (isinstance(va, (int, float)) or isinstance(vb, (int, float))):
-            if name == "shuffle/transport" and va != vb:
+            if (name in ("shuffle/transport", "shuffle/exchange_collective")
+                    and va != vb):
                 # a transport flip under the same config hash (an auto-
                 # routing change) is the usual explanation for a spill
                 # gate hit — it must show in the diff rows, or the
                 # "unexplained spill growth" message sends the reader
                 # hunting for a demotion regression that isn't there
                 rows.append((name, va, vb, None))
+            if name == "plan/exchange_collective" and va != vb:
+                # collective-selection gate: the chooser flipping the
+                # exchange wire program under the same config hash is
+                # only a regression when the run it steered measured a
+                # WORSE exchange wall — a flip that paid is the store
+                # doing its job and must not flag
+                rows.append((name, va, vb, None))
+                # attrib/collective_wait_ms is the measured wall of the
+                # collective wait bucket — the exchange dominates it on
+                # sharded jobs, and it exists on both the single- and
+                # multi-process attribution paths
+                ea = ma.get("attrib/collective_wait_ms")
+                eb = mb.get("attrib/collective_wait_ms")
+                epct = _delta_pct(ea, eb)
+                if (isinstance(ea, (int, float))
+                        and isinstance(eb, (int, float))
+                        and eb - ea > 50.0
+                        and epct is not None and epct > threshold_pct):
+                    regressions.append(
+                        f"{name}: {va} -> {vb} flipped the exchange "
+                        f"collective and the measured collective wall "
+                        f"degraded {ea:,.0f}ms -> {eb:,.0f}ms "
+                        f"(+{epct:.1f}%) (collective selection "
+                        "regression)")
             continue
         pct = _delta_pct(va, vb)
         if name in ("records_per_sec", "rate"):
@@ -388,6 +413,26 @@ def diff_entries(a: dict, b: dict, threshold_pct: float = 10.0,
                 regressions.append(
                     f"{name}: {va:.1f}% -> {vb:.1f}% predicted-vs-"
                     "actual wall error (plan model drift)")
+        elif name == "calib/coverage_pct":
+            # coverage-plane gate: the share of needed calibration cells
+            # the store can answer DROPPING by more than the gate points
+            # means the chooser went from informed to guessing (a wiped
+            # or re-identified store) — gate before the guess costs a
+            # mispredicted job.  Points, not relative percent, and a
+            # missing baseline (a pre-coverage entry) is unknown, not 0
+            from map_oxidize_tpu.obs.calib import (
+                CALIB_COVERAGE_GATE_POINTS,
+            )
+
+            if va != vb:
+                rows.append((name, va, vb, pct))
+            if (isinstance(va, (int, float))
+                    and isinstance(vb, (int, float))
+                    and va - vb > CALIB_COVERAGE_GATE_POINTS):
+                regressions.append(
+                    f"{name}: {va:.1f}% -> {vb:.1f}% of needed "
+                    "calibration cells covered (chooser evidence "
+                    "regression)")
         elif name == "heartbeat/stalls":
             # stall episodes are evidence of a wedged feed loop or a
             # straggler-gated collective; ANY increase flags

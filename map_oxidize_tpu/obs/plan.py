@@ -60,6 +60,13 @@ def publish(registry, doc: dict) -> None:
     pred = doc.get("predicted")
     if pred and pred.get("wall_ms") is not None:
         registry.set("plan/predicted_wall_ms", pred["wall_ms"])
+    # the coverage plane: needs-vs-has over the chooser's consulted
+    # cells, on EVERY planned job (both gauges gate in `obs diff`)
+    cov = doc.get("coverage")
+    if cov:
+        registry.set("calib/coverage_pct", cov.get("coverage_pct"))
+        registry.set("calib/extrapolation_bucket_distance",
+                     cov.get("extrapolation_bucket_distance"))
 
 
 def finalize(obs, doc: dict, attrib_doc: dict | None) -> dict:
@@ -85,6 +92,23 @@ def finalize(obs, doc: dict, attrib_doc: dict | None) -> dict:
         doc["model_error_pct"] = round(err, 2)
         obs.registry.set("plan/model_error_pct", doc["model_error_pct"])
         obs.registry.set("plan/actual_wall_ms", wall)
+    # score the exchange-collective decision: the chooser predicted a
+    # per-exchange latency from the store curve; the run measured the
+    # real one (sampled collective walls in the comms table).  Both land
+    # in the decision doc so the ledger / `obs plan` can say whether the
+    # substitution actually paid.
+    ex = doc.get("exchange")
+    if ex and ex.get("method"):
+        rows = [r for r in obs.registry.comms_table()
+                if r.get("collective") == ex["method"]
+                and r.get("latency_ms")]
+        if rows:
+            best = max(rows, key=lambda r: r["latency_ms"]["count"])
+            ex["actual_ms_per_exchange"] = round(
+                best["latency_ms"]["mean"], 4)
+            ev = (ex.get("evidence") or {}).get(ex["method"])
+            if isinstance(ev, dict) and ev.get("predicted_ms") is not None:
+                ex["predicted_ms_per_exchange"] = ev["predicted_ms"]
     return doc
 
 
@@ -110,6 +134,23 @@ def render(doc: dict, title: str = "plan vs actual") -> str:
             lines.append(
                 f"  {name:<{width}} = {row.get('value')!s:<10} "
                 f"[{row.get('provenance', '?'):<7}] {evs}".rstrip())
+    ex = doc.get("exchange")
+    if ex and ex.get("method"):
+        line = (f"exchange collective: {ex['method']} "
+                f"[{ex.get('provenance', '?')}] @ {ex.get('bucket')} — "
+                f"{ex.get('reason', '')}")
+        if ex.get("actual_ms_per_exchange") is not None:
+            line += f"; measured {ex['actual_ms_per_exchange']}ms/exchange"
+            if ex.get("predicted_ms_per_exchange") is not None:
+                line += (f" (predicted "
+                         f"{ex['predicted_ms_per_exchange']}ms)")
+        lines.append(line)
+    cov = doc.get("coverage")
+    if cov and cov.get("needed"):
+        lines.append(
+            f"calibration coverage: {cov['covered']}/{cov['needed']} "
+            f"cells ({cov['coverage_pct']}%), worst extrapolation "
+            f"{cov['extrapolation_bucket_distance']} bucket(s)")
     pred = doc.get("predicted")
     actual = doc.get("actual")
     if pred and pred.get("buckets"):

@@ -1,0 +1,266 @@
+"""Collective calibration probe: deterministic microbenchmarks that
+fill the calibration store's collective curves WITHOUT waiting for jobs.
+
+The store's collective rows normally accrete as jobs run (``Obs.finish``
+folds the run's comms table in, ``source: "job"``).  That leaves a cold
+start problem: the exchange-collective chooser
+(``parallel.shuffle.choose_collective``) refuses to substitute until the
+exact payload bucket has enough sampled latencies — so the first N jobs
+on a fresh store always run the hard-coded default.  ``obs calib probe``
+closes the loop: sweep the framework's ACTUAL collective programs —
+
+* ``shuffle/merge`` under the monolithic ``all_to_all`` exchange,
+* ``shuffle/merge`` under the decomposed ``all_gather`` + dynamic-slice
+  resharding (the chooser's alternative wire program),
+* the merge step's ``psum`` counter reduction,
+* the two-level top-k candidate ``all_gather`` (``shuffle/top_k``),
+
+across power-of-two payload buckets on the mesh the jobs will actually
+use (the in-process virtual-device mesh, or the global mesh of an
+initialized ``jax.distributed`` / Gloo 2-process run — the probe only
+reads what jax already sees, so the identity row matches the jobs').
+Rows land in the store through the SAME merge/refusal machinery as job
+evidence, tagged ``source: "probe"`` — attributable forever, never
+double-trusted, pooled with job rows for curve density.
+
+Determinism: inputs are seeded (``numpy.random.default_rng(0)``), the
+bucket -> buffer-shape derivation is pure arithmetic on the SAME payload
+identity the engines record (``exchange_payload_bytes``), and every
+process of a multi-process probe runs the identical sweep in lockstep
+(collectives require it), so two processes probing into two stores
+produce identical row sets.
+
+Latency semantics: the probe times the jitted program wall (dispatch +
+route + wire + sync) per invocation — the exchange rows measure the
+``_exchange`` body the real merge step runs, minus the segment-combine.
+Probe and job rows pool in ``interpolate_latency_ms`` but stay split in
+``collective_evidence.by_source`` and the ``obs calib`` render.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+#: default payload sweep: every pow2 bucket a small-to-medium job's
+#: exchange lands in (the fold engine's derived cap at default batch
+#: sizes sits around 64KB-256KB on an 8-shard mesh)
+DEFAULT_BUCKETS = ("16KB", "32KB", "64KB", "128KB", "256KB",
+                   "512KB", "1MB", "2MB", "4MB")
+#: timed repetitions per (program, bucket) — above the chooser's
+#: CALIB_MIN_SAMPLES floor so one probe makes cells selectable
+DEFAULT_REPS = 5
+#: the fold engine's wordcount value plane (int32 counts) — the probe
+#: prices the same payload identity the engines record
+PROBE_VALUE_ROW_BYTES = 4
+
+
+def _cap_for_bucket(bucket: str, num_shards: int,
+                    row_bytes: int = PROBE_VALUE_ROW_BYTES) -> int | None:
+    """Smallest exchange-buffer cap whose payload identity lands at or
+    above ``bucket``'s floor (the payload then falls INSIDE the bucket
+    whenever one buffer row is smaller than the bucket floor)."""
+    from map_oxidize_tpu.obs.calib import bucket_index
+
+    k = bucket_index(bucket)
+    if k is None:
+        return None
+    target = 1 << k
+    unit = num_shards * num_shards * (8 + row_bytes)
+    return max(1, -(-target // unit))
+
+
+def _probe_inputs(num_shards: int, cap: int, rng) -> tuple:
+    """Seeded per-mesh exchange planes: B = S*cap//2 real rows per shard
+    (expected bucket load cap/2 — no overflow), global row-major."""
+    B = max(num_shards, num_shards * cap // 2)
+    n = num_shards * B
+    hi = rng.integers(0, 1 << 32, size=n, dtype=np.uint32)
+    lo = rng.integers(0, 1 << 32, size=n, dtype=np.uint32)
+    vals = np.ones(n, dtype=np.int32)
+    return hi, lo, vals
+
+
+def _time_reps(fn, inputs, reps: int) -> list:
+    """Compile once untimed, then ``reps`` timed walls (ms) with a full
+    device sync per rep."""
+    import jax
+
+    out = fn(*inputs)
+    jax.block_until_ready(out)
+    walls = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*inputs)
+        jax.block_until_ready(out)
+        walls.append((time.perf_counter() - t0) * 1e3)
+    return walls
+
+
+def run_probe(store_dir: str, num_shards: int = 0,
+              buckets=DEFAULT_BUCKETS, reps: int = DEFAULT_REPS,
+              n_processes: int = 1, backend: str = "auto") -> dict:
+    """Sweep the collective programs across ``buckets`` on the current
+    mesh and merge the measured rows into ``store_dir``'s calibration
+    store with ``source="probe"``.  Returns a summary document (the
+    ``obs calib probe`` CLI renders it)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from map_oxidize_tpu.obs import calib as _calib
+    from map_oxidize_tpu.obs.metrics import MetricsRegistry
+    from map_oxidize_tpu.parallel.mesh import SHARD_AXIS, make_mesh
+    from map_oxidize_tpu.parallel.shuffle import (
+        EXCHANGE_COLLECTIVES,
+        _exchange,
+        exchange_payload_bytes,
+    )
+    from map_oxidize_tpu.utils.jax_compat import shard_map
+
+    mesh = make_mesh(num_shards, backend=backend)
+    S = mesh.shape[SHARD_AXIS]
+    spec = P(SHARD_AXIS)
+    reg = MetricsRegistry()
+    rng = np.random.default_rng(0)
+    cells = []
+    probed_caps = set()
+
+    def _exchange_fn(cap: int, method: str):
+        def body(hi, lo, vals):
+            r_hi, r_lo, r_vals, _ovf = _exchange(hi, lo, vals, S, cap,
+                                                 method=method)
+            return r_hi, r_lo, r_vals
+
+        return jax.jit(shard_map(body, mesh=mesh,
+                                 in_specs=(spec, spec, spec),
+                                 out_specs=(spec, spec, spec)))
+
+    # --- the exchange pair: both wire programs at every bucket ----------
+    for bucket in buckets:
+        cap = _cap_for_bucket(bucket, S)
+        if cap is None or cap in probed_caps:
+            continue  # tiny buckets collapse onto the same cap=1 shape
+        probed_caps.add(cap)
+        payload = exchange_payload_bytes(S, cap, PROBE_VALUE_ROW_BYTES)
+        inputs = _probe_inputs(S, cap, rng)
+        for method in EXCHANGE_COLLECTIVES:
+            walls = _time_reps(_exchange_fn(cap, method), inputs, reps)
+            for ms in walls:
+                reg.comm(method, "shuffle/merge", payload,
+                         shape=(S, cap), latency_ms=ms)
+            cells.append({"collective": method, "program": "shuffle/merge",
+                          "bucket": _calib.shape_bucket(payload),
+                          "payload_bytes": payload, "reps": len(walls),
+                          "mean_ms": round(float(np.mean(walls)), 4)})
+
+    # --- psum: the merge step's replicated counter reduction ------------
+    # payload identity mirrors the engine: n int32 planes replicated
+    # across S shards -> 4*n*S*S global bytes
+    probed_psum = set()
+    for bucket in buckets:
+        k = _calib.bucket_index(bucket)
+        if k is None:
+            continue
+        n = max(1, -(-(1 << k) // (4 * S * S)))
+        if n in probed_psum:
+            continue
+        probed_psum.add(n)
+        payload = 4 * n * S * S
+        x = np.ones(n, dtype=np.int32)
+
+        def psum_body(v):
+            return lax.psum(v, SHARD_AXIS)
+
+        fn = jax.jit(shard_map(psum_body, mesh=mesh, in_specs=(P(),),
+                               out_specs=P()))
+        walls = _time_reps(fn, (x,), reps)
+        for ms in walls:
+            reg.comm("psum", "shuffle/merge", payload, shape=(n,),
+                     latency_ms=ms)
+        cells.append({"collective": "psum", "program": "shuffle/merge",
+                      "bucket": _calib.shape_bucket(payload),
+                      "payload_bytes": payload, "reps": len(walls),
+                      "mean_ms": round(float(np.mean(walls)), 4)})
+
+    # --- top-k candidate all_gather (two-level top-k's wire program) ----
+    probed_topk = set()
+    for bucket in buckets:
+        k_idx = _calib.bucket_index(bucket)
+        if k_idx is None:
+            continue
+        k_local = max(1, -(-(1 << k_idx)
+                           // (S * S * (8 + PROBE_VALUE_ROW_BYTES))))
+        if k_local in probed_topk:
+            continue
+        probed_topk.add(k_local)
+        payload = S * S * k_local * (8 + PROBE_VALUE_ROW_BYTES)
+        n = S * k_local
+        g_hi = rng.integers(0, 1 << 32, size=n, dtype=np.uint32)
+        g_lo = rng.integers(0, 1 << 32, size=n, dtype=np.uint32)
+        g_vals = rng.integers(1, 1 << 20, size=n, dtype=np.int32)
+
+        def topk_body(hi, lo, vals, _k=k_local):
+            a_hi = lax.all_gather(hi, SHARD_AXIS).reshape(-1)
+            a_lo = lax.all_gather(lo, SHARD_AXIS).reshape(-1)
+            a_vals = lax.all_gather(vals, SHARD_AXIS).reshape(-1)
+            v, idx = lax.top_k(a_vals, _k)
+            return jnp.take(a_hi, idx), jnp.take(a_lo, idx), v
+
+        # check_vma=False as in build_sharded_ops: top_k over an
+        # all_gather IS replicated, but the static checker can't prove it
+        fn = jax.jit(shard_map(topk_body, mesh=mesh,
+                               in_specs=(spec, spec, spec),
+                               out_specs=(P(), P(), P()),
+                               check_vma=False))
+        walls = _time_reps(fn, (g_hi, g_lo, g_vals), reps)
+        for ms in walls:
+            reg.comm("all_gather", "shuffle/top_k", payload,
+                     shape=(S, k_local), latency_ms=ms)
+        cells.append({"collective": "all_gather",
+                      "program": "shuffle/top_k",
+                      "bucket": _calib.shape_bucket(payload),
+                      "payload_bytes": payload, "reps": len(walls),
+                      "mean_ms": round(float(np.mean(walls)), 4)})
+
+    # --- merge into the store through the normal machinery --------------
+    ident = _calib.run_identity(n_processes)
+    path = os.path.join(store_dir, _calib.CALIB_FILE)
+    store = _calib.CalibStore(path=path)
+    touched = store.accumulate_run(ident, reg.comms_table(), None,
+                                   source="probe")
+    store.save_merged()
+    return {
+        "schema": "moxt-calib-probe-v1",
+        "identity": ident,
+        "store": path,
+        "num_shards": S,
+        "reps": int(reps),
+        "rows_merged": touched,
+        "store_runs": store.doc.get("runs", 0),
+        "cells": cells,
+    }
+
+
+def render_probe(summary: dict) -> str:
+    """Human-readable probe report (`obs calib probe`)."""
+    ident = summary.get("identity") or {}
+    lines = [
+        f"calibration probe: {summary['rows_merged']} store rows merged "
+        f"into {summary['store']} "
+        f"({ident.get('platform')}/{ident.get('topology')}, "
+        f"{summary['num_shards']} shards, {summary['reps']} reps/cell)",
+        f"  {'collective':<11} {'program':<15} {'bucket':>7} "
+        f"{'payload':>10} {'reps':>5} {'mean_ms':>9}",
+    ]
+    from map_oxidize_tpu.obs.metrics import format_bytes
+
+    for c in summary.get("cells") or []:
+        lines.append(
+            f"  {c['collective']:<11} {c['program']:<15} "
+            f"{c['bucket']:>7} {format_bytes(c['payload_bytes']):>10} "
+            f"{c['reps']:>5} {c['mean_ms']:>9.3f}")
+    return "\n".join(lines)

@@ -337,6 +337,15 @@ def build_status(obs, config, workload: str | None = None) -> dict:
     # running job show the promise; archived ones show the verdict
     if getattr(obs, "plan", None):
         doc["plan"] = obs.plan
+    # the calibration plane: store warmth (calib/store_runs — 0 on a
+    # restarted server with a wiped store), coverage of the chooser's
+    # needed cells, merge/load refusals, and the selection the planner
+    # made (doc["plan"]["exchange"] carries the full decision)
+    cal = {k[len("calib/"):]: v
+           for k, v in obs.registry.gauges.items()
+           if k.startswith("calib/")}
+    if cal:
+        doc["calib"] = cal
     # the data-plane headline (conservation, skew, reduction): either
     # the live audit mid-run, or the published data/* gauges post-finish
     dp = getattr(obs, "dataplane", None)

@@ -68,7 +68,10 @@ TIMELINE_CAP = 128
 
 _KINDS = ("value", "delta", "rate")
 _OPS = (">", ">=", "<", "<=")
-_SEVERITIES = ("warning", "critical")
+#: "info" is visibility without urgency (e.g. the fleet's cold-
+#: calibration-store rule): it fires, correlates, and lands in exports
+#: like any alert, but readers may render it below warnings
+_SEVERITIES = ("info", "warning", "critical")
 #: "fleet" arms only on a fleet collector's evaluator
 #: (:mod:`map_oxidize_tpu.obs.fleet`), whose merged cross-target series
 #: no single job or server ever records

@@ -83,10 +83,15 @@ class ShardedCollectEngine:
 
     def __init__(self, config: JobConfig, mesh=None, bucket_cap: int = 0,
                  max_rows: int = 1 << 27, splitters=None,
-                 pair_order: str = "stable", transport: str | None = None):
+                 pair_order: str = "stable", transport: str | None = None,
+                 exchange_method: str = "all_to_all"):
         from map_oxidize_tpu.shuffle import make_transport, resolve_transport
 
         self.config = config
+        #: wire program for the routing exchange (the chooser's knob,
+        #: resolved by the driver): "all_to_all" or the decomposed
+        #: "all_gather" resharding — same routed rows either way
+        self.exchange_method = exchange_method
         self.mesh = mesh if mesh is not None else make_mesh(
             config.num_shards, config.backend)
         self.S = S = self.mesh.shape[SHARD_AXIS]
@@ -151,7 +156,8 @@ class ShardedCollectEngine:
             vals = jnp.stack([dhi, dlo], axis=1)
             r_hi, r_lo, r_vals, ovf = _exchange(
                 hi, lo, vals, S, self.bucket_cap,
-                dest=self._dest_of(hi, lo))
+                dest=self._dest_of(hi, lo),
+                method=self.exchange_method)
             # compact: 2-key sort moves SENTINEL rows (key = max) to the
             # end; doc planes ride along
             s_h, s_l, s_dh, s_dl = lax.sort(
@@ -172,14 +178,16 @@ class ShardedCollectEngine:
 
         # the range-routed variant is a genuinely different XLA program
         # under the same ledger name; the tag keeps the two cache slots
-        # (and recompile causes) apart, same scheme as collect/grow
+        # (and recompile causes) apart, same scheme as collect/grow.
+        # The exchange method joins the tag for the same reason: a
+        # chooser flip is a new program, not a mystery recompile.
         self._route_append = observed_jit("collect/route_append", jax.jit(
             shard_map(
                 _route_append, mesh=self.mesh,
                 in_specs=(row2,) * 4 + (spec,) * 5,
                 out_specs=(row2,) * 4 + (spec, P()),
             ), donate_argnums=(0, 1, 2, 3, 4)),
-            tag="range" if self.splitters is not None else None)
+            tag=self._program_tag())
 
         def _grow(bh, bl, bdh, bdl, pad):
             filler = jnp.full((1, pad), jnp.uint32(SENTINEL))
@@ -429,6 +437,16 @@ class ShardedCollectEngine:
             self._overflows.append(ovf)
             self._record_exchange(n, t0, ovf)
 
+    def _program_tag(self):
+        """Compile-ledger cache-slot tag for the routing programs: the
+        partition discipline (range vs hash) crossed with the exchange
+        method — each combination is its own XLA program."""
+        tag = "range" if self.splitters is not None else None
+        if self.exchange_method != "all_to_all":
+            tag = (f"{tag}+{self.exchange_method}" if tag
+                   else self.exchange_method)
+        return tag
+
     def _record_exchange(self, n: int, t0: float, ovf,
                          program: str = "collect/route_append") -> None:
         """Shuffle counters + comms-observatory row for one exchange
@@ -436,7 +454,10 @@ class ShardedCollectEngine:
         disk transport's route-to-spill exchange, which passes its own
         ``program`` name).  Doc planes ride as an 8-byte value row
         (dhi, dlo); latency is sampled on the xprof cadence by forcing
-        the tiny replicated overflow scalar."""
+        the tiny replicated overflow scalar.  The comms row is keyed on
+        the ACTIVE exchange collective (the chooser's pick); the
+        ``shuffle/all_to_all_bytes`` counter stays the method-agnostic
+        logical-exchange accounting identity the merge report reads."""
         if self.obs is None:
             return
         from map_oxidize_tpu.obs.metrics import sample_collective_wall
@@ -447,8 +468,9 @@ class ShardedCollectEngine:
         reg.count("shuffle/exchanges")
         reg.count("shuffle/rows_exchanged", n)
         reg.count("shuffle/all_to_all_bytes", payload)
+        reg.set("shuffle/exchange_collective", self.exchange_method)
         lat_ms = sample_collective_wall(self, "_n_appends", t0, ovf)
-        reg.comm("all_to_all", program, payload,
+        reg.comm(self.exchange_method, program, payload,
                  shape=(self.S, self.bucket_cap), latency_ms=lat_ms)
 
     def finalize(self):

@@ -92,7 +92,8 @@ class DistributedReduceEngine:
     processes.
     """
 
-    def __init__(self, config: JobConfig, reducer=None, mesh=None):
+    def __init__(self, config: JobConfig, reducer=None, mesh=None,
+                 exchange_method: str = "all_to_all"):
         import jax
 
         from map_oxidize_tpu.parallel.engine import ShardedReduceEngine
@@ -102,7 +103,7 @@ class DistributedReduceEngine:
             config.num_shards, config.backend)
         self._eng = ShardedReduceEngine(
             config, reducer if reducer is not None else SumReducer(),
-            mesh=self.mesh)
+            mesh=self.mesh, exchange_method=exchange_method)
         # replace the host-sync reads with replicate-then-read versions
         self._eng._read_live = self._read_live
         self._eng._check_health = self._check_health
@@ -380,7 +381,8 @@ class DistributedCollectEngine(ShardedCollectEngineBase):
         def _route(hi, lo, dhi, dlo):
             vals = jnp.stack([dhi, dlo], axis=1)
             r_hi, r_lo, r_vals, ovf = _exchange(
-                hi, lo, vals, S, cap, dest=self._dest_of(hi, lo))
+                hi, lo, vals, S, cap, dest=self._dest_of(hi, lo),
+                method=self.exchange_method)
             return (r_hi[None], r_lo[None], r_vals[:, 0][None],
                     r_vals[:, 1][None], ovf)
 
@@ -389,7 +391,7 @@ class DistributedCollectEngine(ShardedCollectEngineBase):
         return observed_jit("shuffle/route_spill", jax.jit(shard_map(
             _route, mesh=self.mesh, in_specs=(spec,) * 4,
             out_specs=(row2,) * 4 + (P(),))),
-            tag="range" if self.splitters is not None else None)
+            tag=self._program_tag())
 
     def _route_to_spill(self, batch, n: int) -> None:
         import time as _time
@@ -971,12 +973,17 @@ def _run_distributed_core(config: JobConfig, workload: str, obs: Obs
         config, cap, name=obs.knob("shuffle_transport",
                                    config.shuffle_transport))
     push_mode = transport == "pipelined"
+    from map_oxidize_tpu.runtime.driver import solved_exchange
+
+    exchange = solved_exchange(config, obs)
     if workload == "wordcount":
         mapper, reducer = make_wordcount(config.tokenizer, use_native)
-        engine = DistributedReduceEngine(config, reducer)
+        engine = DistributedReduceEngine(config, reducer,
+                                         exchange_method=exchange)
     elif workload == "bigram":
         mapper, reducer = make_bigram(config.tokenizer, use_native)
-        engine = DistributedReduceEngine(config, reducer)
+        engine = DistributedReduceEngine(config, reducer,
+                                         exchange_method=exchange)
     elif workload == "invertedindex":
         from map_oxidize_tpu.workloads.inverted_index import (
             make_inverted_index,
@@ -986,6 +993,7 @@ def _run_distributed_core(config: JobConfig, workload: str, obs: Obs
 
         mapper = make_inverted_index(config.tokenizer, config.use_native)
         engine = DistributedCollectEngine(config, transport=transport,
+                                          exchange_method=exchange,
                                           **collect_engine_kw(config))
     else:
         raise ValueError(f"unknown distributed workload {workload!r}")
@@ -1550,6 +1558,30 @@ def finish_distributed_obs(obs: Obs, config: JobConfig, workload: str
     attrib_doc = _attrib.finalize(
         obs, xprof_report,
         max(_time.time() - obs.tracer.wall_start, 1e-9))
+    # score the plan (exchange decision + model error) and fold this
+    # process's measurements into the calibration store — the same
+    # evidence loop Obs.finish runs, so distributed jobs warm the
+    # collective curves their next plan reads.  Every process merges
+    # its own comms rows (the store's flock'd read-merge-write is the
+    # concurrency contract); only process 0 accumulates the workload
+    # wall curve, so the job counts once.
+    if obs.plan is not None:
+        from map_oxidize_tpu.obs import plan as _plan
+
+        try:
+            _plan.finalize(obs, obs.plan, attrib_doc)
+        except Exception:  # scoring is evidence, never a job failure
+            pass
+    import os as _os
+
+    corpus_bytes = 0.0
+    try:
+        corpus_bytes = float(_os.path.getsize(config.input_path))
+    except (OSError, TypeError, AttributeError):
+        pass
+    obs._merge_calibration(
+        xprof_report, workload=workload if obs.process == 0 else None,
+        corpus_bytes=corpus_bytes, attrib_doc=attrib_doc)
     sample_host_memory(obs.registry)
     sample_device_memory(obs.registry)
     if obs.heartbeat is not None:
@@ -1563,6 +1595,8 @@ def finish_distributed_obs(obs: Obs, config: JobConfig, workload: str
     meta = obs.stamp(config, workload)
     metrics_doc = dict(obs.registry.to_dict(), meta=meta,
                        attrib=attrib_doc)
+    if obs.plan is not None:
+        metrics_doc["plan"] = obs.plan
     if data_doc is not None:
         metrics_doc["data"] = data_doc
     if xprof_report is not None:
@@ -1635,6 +1669,9 @@ def finish_distributed_obs(obs: Obs, config: JobConfig, workload: str
         if skew:
             extra = {"records_total": skew.get("records_total"),
                      "skew": skew.get("skew")}
+        if obs.plan is not None:
+            # the full plan doc rides the entry, same as Obs.finish
+            extra["plan"] = obs.plan
         if critpath_doc and not critpath_doc.get("error"):
             # the compact causal summary (full segments stay in the
             # skew report next to the merged trace)

@@ -50,9 +50,13 @@ class ShardedReduceEngine(StreamingEngineBase):
         mesh=None,
         bucket_cap: int = 0,
         overflow_check_every: int = 16,
+        exchange_method: str = "all_to_all",
     ):
         super().__init__(config, reducer, value_shape, value_dtype,
                          overflow_check_every)
+        #: wire program for the shuffle exchange — the chooser's knob
+        #: (parallel.shuffle.choose_collective), resolved by the driver
+        self.exchange_method = exchange_method
         self.mesh = mesh if mesh is not None else make_mesh(
             config.num_shards, config.backend
         )
@@ -68,7 +72,8 @@ class ShardedReduceEngine(StreamingEngineBase):
         self._sharding = sharded(self.mesh)
 
         self._merge, self._topk, self._grow, self.bucket_cap = build_sharded_ops(
-            self.mesh, self.combine, bucket_cap, self.batch_per_shard
+            self.mesh, self.combine, bucket_cap, self.batch_per_shard,
+            exchange_method=exchange_method,
         )
         # jitted fill with out_shardings: materializes directly on the mesh
         # (no host buffer over the slow link) and never touches the default
@@ -141,7 +146,11 @@ class ShardedReduceEngine(StreamingEngineBase):
                 int(self.value_dtype.itemsize
                     * max(1, int(np.prod(self.value_shape, dtype=np.int64)))
                     ))
+            # method-agnostic logical-exchange accounting identity (the
+            # merge report and gates read this name regardless of which
+            # wire program the chooser picked)
             reg.count("shuffle/all_to_all_bytes", payload)
+            reg.set("shuffle/exchange_collective", self.exchange_method)
             # the per-merge psum payloads: the [S] unique counts + the [S]
             # overflow counter, int32 each, replicated over S shards
             psum_payload = 2 * 4 * self.S * self.S
@@ -150,7 +159,7 @@ class ShardedReduceEngine(StreamingEngineBase):
 
             lat_ms = sample_collective_wall(self, "_exchanges", t0,
                                             self._overflow)
-            reg.comm("all_to_all", "shuffle/merge", payload,
+            reg.comm(self.exchange_method, "shuffle/merge", payload,
                      shape=(self.S, self.bucket_cap), latency_ms=lat_ms)
             reg.comm("psum", "shuffle/merge", psum_payload,
                      shape=(self.S,))

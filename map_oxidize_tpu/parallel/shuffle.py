@@ -43,14 +43,105 @@ from map_oxidize_tpu.parallel.mesh import SHARD_AXIS
 from map_oxidize_tpu.utils.jax_compat import shard_map
 
 
+#: the exchange programs the chooser can route through: the monolithic
+#: ``all_to_all`` and its portable decomposition (arXiv:2112.01075) —
+#: ``all_gather`` every shard's send buffer, then dynamic-slice the
+#: block addressed to this shard.  Same routed rows bit for bit; which
+#: one is faster depends on (payload bucket, topology), which is what
+#: the calibration store measures.
+EXCHANGE_COLLECTIVES = ("all_to_all", "all_gather")
+
+
 def exchange_payload_bytes(num_shards: int, bucket_cap: int,
                            value_row_bytes: int) -> int:
     """Bytes one full exchange moves over ICI/DCN: every shard sends a
     ``[S, cap]`` buffer of (hi, lo, value) planes, so the global payload
     is ``S * S * cap`` rows of ``8 + value_row_bytes`` each.  A host-side
     accounting identity for the metrics registry — the collective itself
-    is inside XLA and can't self-report."""
+    is inside XLA and can't self-report.  The SAME identity prices both
+    exchange methods (the all_gather decomposition moves more raw bytes,
+    but its measured latency curve is keyed on the logical exchange
+    payload so the chooser compares like with like)."""
     return num_shards * num_shards * bucket_cap * (8 + value_row_bytes)
+
+
+def choose_collective(store, ident: dict, num_shards: int,
+                      bucket_cap: int, value_row_bytes: int = 8,
+                      min_samples: int | None = None,
+                      requested: str = "auto") -> dict:
+    """The store-driven exchange-collective decision (ROADMAP item 2's
+    "auto-selected from the calibration store rather than hard-coded").
+
+    Prices one full exchange at this job's measured payload bucket under
+    both :data:`EXCHANGE_COLLECTIVES` curves and picks the cheaper —
+    but ONLY when the store's evidence is trustworthy: an exact-bucket
+    curve with at least ``min_samples`` sampled latencies for BOTH
+    methods.  Anything less falls back to the hard-coded default with a
+    NAMED reason (``provenance: default``) — a cold store, a bucket the
+    curves only cover by extrapolation, or thin evidence must never
+    silently steer the exchange.  ``requested != "auto"`` short-circuits
+    as a user pin (``provenance: pinned``).
+
+    Returns the decision document the plan doc / ledger / ``/status``
+    carry verbatim: ``{method, provenance, reason, bucket,
+    payload_bytes, evidence: {collective: {predicted_ms, samples,
+    by_source, bucket_distance}}}``."""
+    from map_oxidize_tpu.obs.calib import (
+        CALIB_MIN_SAMPLES,
+        collective_evidence,
+        interpolate_latency_ms,
+        shape_bucket,
+    )
+
+    if min_samples is None:
+        min_samples = CALIB_MIN_SAMPLES
+    payload = exchange_payload_bytes(num_shards, bucket_cap,
+                                     value_row_bytes)
+    bucket = shape_bucket(payload)
+    default = EXCHANGE_COLLECTIVES[0]
+    decision: dict = {"bucket": bucket, "payload_bytes": int(payload)}
+    if requested != "auto":
+        decision.update(method=requested, provenance="pinned",
+                        reason=f"user pinned {requested}",
+                        evidence={"requested": requested})
+        return decision
+    evidence: dict = {}
+    fallback_reason = None
+    for coll in EXCHANGE_COLLECTIVES:
+        ev = collective_evidence(store, ident, coll, bucket)
+        lat = interpolate_latency_ms(store, ident, coll, payload)
+        evidence[coll] = {
+            "predicted_ms": None if lat is None else round(lat, 4),
+            "samples": ev["samples"], "by_source": ev["by_source"],
+            "bucket_distance": ev["bucket_distance"],
+        }
+        if fallback_reason is not None:
+            continue
+        if lat is None or ev["bucket_distance"] is None:
+            fallback_reason = (f"cold store: no sampled {coll} curve "
+                               f"under this identity")
+        elif ev["bucket_distance"] > 0:
+            fallback_reason = (
+                f"out of bucket range: nearest sampled {coll} bucket is "
+                f"{ev['bucket_distance']} pow2 step(s) from {bucket} "
+                "(extrapolation, not evidence)")
+        elif ev["samples"] < min_samples:
+            fallback_reason = (
+                f"below min-samples floor: {coll}@{bucket} has "
+                f"{ev['samples']} sampled latencies < {min_samples}")
+    decision["evidence"] = evidence
+    if fallback_reason is not None:
+        decision.update(method=default, provenance="default",
+                        reason=fallback_reason)
+        return decision
+    best = min(EXCHANGE_COLLECTIVES,
+               key=lambda c: evidence[c]["predicted_ms"])
+    decision.update(
+        method=best, provenance="curve",
+        reason=(f"store curve @ {bucket}: "
+                + " vs ".join(f"{c} {evidence[c]['predicted_ms']}ms"
+                              for c in EXCHANGE_COLLECTIVES)))
+    return decision
 
 
 def bucket_of(hi: jnp.ndarray, lo: jnp.ndarray, num_shards: int) -> jnp.ndarray:
@@ -78,15 +169,25 @@ def range_dest(hi, lo, sp_hi, sp_lo) -> jnp.ndarray:
     return jnp.sum(ge.astype(jnp.int32), axis=1)
 
 
-def _exchange(hi, lo, vals, num_shards: int, cap: int, dest=None):
-    """Per-shard body: route rows to their owner shard via all_to_all.
+def _exchange(hi, lo, vals, num_shards: int, cap: int, dest=None,
+              method: str = "all_to_all"):
+    """Per-shard body: route rows to their owner shard.
 
     ``dest`` overrides the hash-bucket destination per row (the sort
     engine's range partition); padding rows are re-routed round-robin
-    either way.  Returns ``(hi, lo, vals)`` of shape ``[S*cap, ...]`` —
-    the rows this shard owns after the exchange — plus the global count of
-    overflow-dropped rows (replicated scalar; caller raises on nonzero).
+    either way.  ``method`` picks the wire program
+    (:data:`EXCHANGE_COLLECTIVES`): the monolithic ``all_to_all``, or
+    the decomposed ``all_gather`` + dynamic-slice resharding — identical
+    routed rows by construction (the slice extracts exactly the block
+    ``all_to_all`` would have delivered), so the chooser can flip
+    methods without touching results.  Returns ``(hi, lo, vals)`` of
+    shape ``[S*cap, ...]`` — the rows this shard owns after the exchange
+    — plus the global count of overflow-dropped rows (replicated scalar;
+    caller raises on nonzero).
     """
+    if method not in EXCHANGE_COLLECTIVES:
+        raise ValueError(f"exchange method must be one of "
+                         f"{EXCHANGE_COLLECTIVES}, got {method!r}")
     B = hi.shape[0]
     S = num_shards
     is_pad = (hi == jnp.uint32(SENTINEL)) & (lo == jnp.uint32(SENTINEL))
@@ -123,11 +224,27 @@ def _exchange(hi, lo, vals, num_shards: int, cap: int, dest=None):
     buf_lo = buf_lo.at[dest_s, rank].set(lo_s, mode="drop")
     buf_vals = buf_vals.at[dest_s, rank].set(vals_s, mode="drop")
 
-    # ICI exchange: row block [d, :] goes to shard d; received block i came
-    # from shard i.  tiled=True keeps the [S, cap] shape.
-    ex_hi = lax.all_to_all(buf_hi, SHARD_AXIS, 0, 0, tiled=True)
-    ex_lo = lax.all_to_all(buf_lo, SHARD_AXIS, 0, 0, tiled=True)
-    ex_vals = lax.all_to_all(buf_vals, SHARD_AXIS, 0, 0, tiled=True)
+    if method == "all_gather":
+        # decomposed resharding: gather every shard's [S, cap] send
+        # buffer ([S_src, S, cap]) and dynamic-slice column `my` —
+        # g[i, my] is exactly the block shard i addressed to this shard,
+        # i.e. the row block all_to_all would have delivered
+        my = lax.axis_index(SHARD_AXIS)
+
+        def _reshard(buf):
+            g = lax.all_gather(buf, SHARD_AXIS)
+            return lax.dynamic_index_in_dim(g, my, axis=1,
+                                            keepdims=False)
+
+        ex_hi = _reshard(buf_hi)
+        ex_lo = _reshard(buf_lo)
+        ex_vals = _reshard(buf_vals)
+    else:
+        # ICI exchange: row block [d, :] goes to shard d; received block
+        # i came from shard i.  tiled=True keeps the [S, cap] shape.
+        ex_hi = lax.all_to_all(buf_hi, SHARD_AXIS, 0, 0, tiled=True)
+        ex_lo = lax.all_to_all(buf_lo, SHARD_AXIS, 0, 0, tiled=True)
+        ex_vals = lax.all_to_all(buf_vals, SHARD_AXIS, 0, 0, tiled=True)
 
     total_overflow = lax.psum(overflow, SHARD_AXIS)
     flat = (S * cap,)
@@ -140,7 +257,8 @@ def _exchange(hi, lo, vals, num_shards: int, cap: int, dest=None):
 
 
 def _merge_step(acc_hi, acc_lo, acc_vals, ovf_in, b_hi, b_lo, b_vals,
-                num_shards: int, cap: int, combine: str):
+                num_shards: int, cap: int, combine: str,
+                method: str = "all_to_all"):
     """Per-shard body of one streaming fold: pre-combine the local batch,
     shuffle it, then sort+segment-combine into this shard's accumulator.
     ``ovf_in`` is the running overflow counter — carried through the step so
@@ -153,7 +271,9 @@ def _merge_step(acc_hi, acc_lo, acc_vals, ovf_in, b_hi, b_lo, b_vals,
     # overflow cap.  Also shrinks ICI bytes by the duplication factor, and the
     # sort it costs was going to be paid post-exchange anyway.
     b_hi, b_lo, b_vals, _ = reduce_pairs(b_hi, b_lo, b_vals, combine)
-    r_hi, r_lo, r_vals, overflow = _exchange(b_hi, b_lo, b_vals, num_shards, cap)
+    r_hi, r_lo, r_vals, overflow = _exchange(b_hi, b_lo, b_vals,
+                                             num_shards, cap,
+                                             method=method)
     hi = jnp.concatenate([acc_hi, r_hi])
     lo = jnp.concatenate([acc_lo, r_lo])
     vals = jnp.concatenate([acc_vals, r_vals])
@@ -203,7 +323,8 @@ def _topk_step(acc_hi, acc_lo, acc_vals, k_local: int, k_final: int):
 
 
 def build_sharded_ops(mesh, combine: str = "sum", bucket_cap: int = 0,
-                      batch_per_shard: int = 0):
+                      batch_per_shard: int = 0,
+                      exchange_method: str = "all_to_all"):
     """Compile the sharded merge step and top-k for ``mesh``.
 
     Returns ``(merge_fn, topk_fn)``:
@@ -229,15 +350,20 @@ def build_sharded_ops(mesh, combine: str = "sum", bucket_cap: int = 0,
 
     spec = P(SHARD_AXIS)
     merge = shard_map(
-        partial(_merge_step, num_shards=S, cap=bucket_cap, combine=combine),
+        partial(_merge_step, num_shards=S, cap=bucket_cap,
+                combine=combine, method=exchange_method),
         mesh=mesh,
         in_specs=(spec,) * 7,
         out_specs=(spec, spec, spec, spec, spec),
     )
     from map_oxidize_tpu.obs.compile import observed_jit
 
+    # the exchange method is part of the program identity: a chooser
+    # flip IS a new XLA program, and the compile ledger must see it as
+    # one (not a mystery recompile of the same name)
     merge = observed_jit("shuffle/merge",
-                         jax.jit(merge, donate_argnums=(0, 1, 2, 3)))
+                         jax.jit(merge, donate_argnums=(0, 1, 2, 3)),
+                         tag=exchange_method)
 
     @lru_cache(maxsize=None)
     def _topk_compiled(k_local: int, k_final: int):

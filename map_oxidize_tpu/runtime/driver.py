@@ -82,8 +82,19 @@ def solved_transport(config: JobConfig, obs: Obs) -> str:
                                            config.shuffle_transport))
 
 
+def solved_exchange(config: JobConfig, obs: Obs) -> str:
+    """The route from the planner's ``exchange_collective`` knob to the
+    concrete wire program (:data:`parallel.shuffle.EXCHANGE_COLLECTIVES`):
+    the knob value (pins echoed verbatim by the planner) with the
+    hard-coded ``all_to_all`` default when no plan resolved one — an
+    unplanned or cold run never guesses."""
+    method = obs.knob("exchange_collective", config.exchange_collective)
+    return "all_to_all" if method in (None, "", "auto") else str(method)
+
+
 def make_engine(config: JobConfig, reducer, value_shape=(), value_dtype=np.int32,
-                wide_keys: bool = False, transport: str | None = None):
+                wide_keys: bool = False, transport: str | None = None,
+                exchange_method: str = "all_to_all"):
     """Pick the engine: shard count selects single-chip vs the all_to_all
     mesh engine, and ``reduce_mode`` (or the mapper's ``wide_keys``
     declaration under 'auto') selects the streaming fold vs the host
@@ -118,7 +129,8 @@ def make_engine(config: JobConfig, reducer, value_shape=(), value_dtype=np.int32
     from map_oxidize_tpu.parallel.engine import ShardedReduceEngine
 
     return ShardedReduceEngine(config, reducer, value_shape=value_shape,
-                               value_dtype=value_dtype)
+                               value_dtype=value_dtype,
+                               exchange_method=exchange_method)
 
 
 class LazyCounts(Mapping):
@@ -313,7 +325,8 @@ def _run_wordcount_body(config: JobConfig, obs: Obs, mapper: Mapper,
                          value_shape=mapper.value_shape,
                          value_dtype=mapper.value_dtype,
                          wide_keys=getattr(mapper, "wide_keys", False),
-                         transport=transport)
+                         transport=transport,
+                         exchange_method=solved_exchange(config, obs))
     engine.obs = obs
     if getattr(engine, "transport", None):
         # collect engines carry a shuffle transport; fold engines don't
@@ -578,8 +591,10 @@ def _run_inverted_index_body(config: JobConfig, obs: Obs
             _log.info("collect_sort=%r applies to the single-chip engine "
                       "only; the sharded path sorts per shard on device",
                       config.collect_sort)
-        engine = ShardedCollectEngine(config, transport=transport,
-                                      **collect_engine_kw(config))
+        engine = ShardedCollectEngine(
+            config, transport=transport,
+            exchange_method=solved_exchange(config, obs),
+            **collect_engine_kw(config))
     else:
         from map_oxidize_tpu.runtime.collect import CollectEngine
 

@@ -51,7 +51,12 @@ _log = get_logger(__name__)
 
 #: every knob a plan documents, in render order
 PLAN_KNOBS = ("dispatch_batch", "pipeline_depth", "chunk_bytes",
-              "shuffle_transport", "sort_sample")
+              "shuffle_transport", "sort_sample", "exchange_collective")
+
+#: workloads whose mesh path routes through the pair-collect engines
+#: (fully-safe bucket cap, 8-byte doc planes); everything else that
+#: exchanges uses the fold engine's derived cap and 4-byte values
+_COLLECT_WORKLOADS = ("invertedindex", "sort", "join", "sessionize")
 
 #: which jitted program each workload's batched streamed dispatch runs —
 #: auto-B is solved per program, and only the streamed k-means path has
@@ -265,6 +270,37 @@ def build_plan(config, workload: str, calib_prior=None,
     _knob("sort_sample", int(config.sort_sample),
           "pinned" if "sort_sample" in pins else "default", ev)
 
+    # exchange_collective — the store-driven collective substitution
+    # (ROADMAP item 2's "auto-selected from the calibration store"):
+    # choose_collective prices the monolithic all_to_all against the
+    # decomposed all_gather + dynamic-slice resharding at this job's
+    # payload bucket, from probe-/job-sourced curves, refusing onto the
+    # default with a NAMED reason on cold/thin/extrapolated evidence.
+    # Applied via Obs.knob at every engine-construction site
+    # (runtime.driver.solved_exchange).  The coverage plane rides
+    # along: which (collective, bucket) cells this job NEEDS vs HAS.
+    from map_oxidize_tpu.parallel.shuffle import (
+        EXCHANGE_COLLECTIVES,
+        choose_collective,
+    )
+
+    n_shards = int(getattr(config, "num_shards", 0) or 0)
+    if n_shards <= 0:
+        n_shards = int(ident.get("device_count") or 0) or 1
+    cap_rows, row_bytes = _calib.exchange_shape(
+        n_shards, int(getattr(config, "batch_size", 1) or 1),
+        collect=workload in _COLLECT_WORKLOADS)
+    decision = choose_collective(
+        calib_prior, ident, n_shards, cap_rows, row_bytes,
+        min_samples=int(getattr(config, "calib_min_samples", 0) or 0)
+        or None,
+        requested=str(getattr(config, "exchange_collective", "auto")
+                      or "auto"))
+    _knob("exchange_collective", decision["method"],
+          decision["provenance"],
+          {"reason": decision["reason"], "bucket": decision["bucket"],
+           "payload_bytes": decision["payload_bytes"]})
+
     doc = {
         "schema": PLAN_SCHEMA,
         "mode": getattr(config, "plan", "auto"),
@@ -274,6 +310,16 @@ def build_plan(config, workload: str, calib_prior=None,
         "pins": sorted(pins),
         "knobs": knobs,
         "provenance": "platform_default",
+        # the full chooser decision (evidence curves included) and the
+        # needs-vs-has coverage over the cells it consulted — published
+        # as calib/* gauges by obs.plan.publish on EVERY planned job
+        "exchange": decision,
+        "coverage": _calib.coverage_report(
+            calib_prior, ident,
+            [{"collective": c, "bucket": decision["bucket"]}
+             for c in EXCHANGE_COLLECTIVES] if n_shards > 1 else [],
+            min_samples=int(getattr(config, "calib_min_samples", 0)
+                            or 0) or _calib.CALIB_MIN_SAMPLES),
     }
     if wl_curve and shape["corpus_bytes"] > 0:
         mb = shape["corpus_bytes"] / (1 << 20)

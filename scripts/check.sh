@@ -704,6 +704,81 @@ print("plan OK: doctored store tripped the gate with a named reason; "
       "store file left intact")
 EOF
 
+echo "== calibration probe smoke =="
+# ISSUE-20 acceptance: one probe on a COLD store gives the very next
+# job enough evidence to auto-select the exchange collective — the
+# decision rides the plan doc with probe-sourced evidence, and the
+# coverage gauges publish on the planned job and its ledger entry.
+# Buckets chosen so the follow-up job's derived exchange payload
+# (batch 65536 / 8 shards -> cap 2064 -> ~1.5MB -> bucket 1MB) lands
+# INSIDE the probed range.
+JAX_PLATFORMS=cpu python -m map_oxidize_tpu obs calib probe \
+    "$smoke/probe_calib" --num-shards 8 \
+    --buckets 256KB 512KB 1MB --reps 3 --json \
+    > "$smoke/probe_summary.json"
+python - "$smoke" <<'EOF'
+import json, sys
+s = json.load(open(f"{sys.argv[1]}/probe_summary.json"))
+cells = s["cells"]
+colls = {c["collective"] for c in cells}
+assert {"all_to_all", "all_gather", "psum"} <= colls, colls
+for coll in ("all_to_all", "all_gather"):
+    buckets = {c["bucket"] for c in cells
+               if c["collective"] == coll and c["program"] == "shuffle/merge"}
+    assert len(buckets) >= 3, (coll, buckets)
+assert s["rows_merged"] >= 8 and s["store_runs"] == 1, s
+print(f"probe OK: {s['rows_merged']} rows across {sorted(colls)}")
+EOF
+JAX_PLATFORMS=cpu python -m map_oxidize_tpu obs calib coverage \
+    "$smoke/probe_calib" --num-shards 8 --batch-size 65536 --json \
+    > "$smoke/probe_coverage.json"
+python - "$smoke" <<'EOF'
+import json, sys
+cov = json.load(open(f"{sys.argv[1]}/probe_coverage.json"))
+assert cov["needed"] >= 2 and cov["coverage_pct"] == 100.0, cov
+assert cov["extrapolation_bucket_distance"] == 0, cov
+print(f"coverage OK: {cov['covered']}/{cov['needed']} cells after one probe")
+EOF
+# the source-grouped render must show the probe rows
+JAX_PLATFORMS=cpu python -m map_oxidize_tpu obs calib show \
+    "$smoke/probe_calib" | grep -q "probe"
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python -m map_oxidize_tpu wordcount "$smoke/corpus.txt" \
+    --output "$smoke/probe_out.txt" --num-shards 8 --batch-size 65536 \
+    --plan auto --quiet --calib-dir "$smoke/probe_calib" \
+    --ledger-dir "$smoke/probe_ledger" \
+    --metrics-out "$smoke/probe_job.json" > /dev/null
+python - "$smoke" <<'EOF'
+import json, sys
+d = sys.argv[1]
+m = json.load(open(f"{d}/probe_job.json"))
+ex = m["plan"]["exchange"]
+# the store curve steered the exchange, on probe-sourced evidence
+assert ex["provenance"] == "curve", ex
+assert ex["method"] in ("all_to_all", "all_gather"), ex
+assert ex["bucket"] == "1MB", ex
+ev = ex["evidence"][ex["method"]]
+assert ev["by_source"].get("probe", 0) >= 3, ev
+assert ev["bucket_distance"] == 0 and ev["predicted_ms"] is not None, ev
+# the decision was applied (engine gauge), scored (measured wall), and
+# the coverage gauges published
+g = m["gauges"]
+assert g["plan/exchange_collective"] == ex["method"], g
+assert g["plan/exchange_collective_provenance"] == "curve", g
+assert g["shuffle/exchange_collective"] == ex["method"], g
+assert g["calib/coverage_pct"] == 100.0, g
+assert g["calib/extrapolation_bucket_distance"] == 0, g
+assert ex.get("actual_ms_per_exchange") is not None, ex
+led = [json.loads(l) for l in open(f"{d}/probe_ledger/ledger.jsonl")]
+lm = led[-1]["metrics"]
+assert lm["calib/coverage_pct"] == 100.0, lm
+assert lm["calib/extrapolation_bucket_distance"] == 0, lm
+assert led[-1]["plan"]["exchange"]["provenance"] == "curve"
+print(f"probe->job OK: {ex['method']} [curve] @ {ex['bucket']}, "
+      f"predicted {ev['predicted_ms']}ms vs measured "
+      f"{ex['actual_ms_per_exchange']}ms/exchange")
+EOF
+
 echo "== live telemetry smoke =="
 # a big-enough HIGH-CARDINALITY corpus (the native mapper pre-combines
 # per chunk, so a repeated-words corpus stages too few rows to flush

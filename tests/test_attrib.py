@@ -315,7 +315,7 @@ def test_calib_round_trip_and_two_run_merge(tmp_path):
         store.save_merged()
     merged = calib.CalibStore.load(path)
     assert merged.doc["runs"] == 2
-    key = "cpu|8|1x8|all_to_all|shuffle/merge|1MB"
+    key = "cpu|8|1x8|all_to_all|shuffle/merge|1MB|job"
     row = merged.doc["comms"][key]
     assert row["calls"] == 20 and row["runs"] == 2
     assert row["bytes"] == 20 * (1 << 20)

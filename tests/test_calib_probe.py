@@ -1,0 +1,441 @@
+"""Collective calibration observatory: the probe harness, the coverage
+plane, and the store-driven exchange-collective chooser.
+
+The chooser units run against DOCTORED stores (hand-built curve rows) so
+every provenance path is pinned without timing anything: a real curve
+steers, a cold store falls back with a named reason, an out-of-range
+bucket is extrapolation-not-evidence, and thin cells stay below the
+min-samples floor.  The probe round-trip actually times the mesh
+programs (in-process 8-virtual-device CPU mesh) and checks the rows land
+through the normal merge machinery tagged ``source="probe"`` — and that
+one probe is enough to flip a cold chooser to ``provenance: curve``.
+The 2-process Gloo probe (slow) checks the lockstep-sweep determinism
+promise: both processes merge IDENTICAL row sets into their own stores.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from map_oxidize_tpu.obs import calib
+from map_oxidize_tpu.parallel.shuffle import (
+    EXCHANGE_COLLECTIVES,
+    choose_collective,
+    exchange_payload_bytes,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+IDENT = {"platform": "cpu", "device_count": 8, "topology": "1x8"}
+# S=8, cap=100, int32 values: 8*8*100*(8+4) = 76800 bytes -> bucket 64KB
+S, CAP, ROW_BYTES = 8, 100, 4
+PAYLOAD = exchange_payload_bytes(S, CAP, ROW_BYTES)
+BUCKET = calib.shape_bucket(PAYLOAD)
+
+
+def _doctored_store(rows, ident=IDENT):
+    """A store holding hand-built curve rows: (collective, bucket,
+    per_call_bytes, mean_ms, samples, source) tuples."""
+    store = calib.CalibStore()
+    for collective, bucket, per_call, mean_ms, samples, source in rows:
+        key = calib._comm_key(ident, collective, "shuffle/merge", bucket,
+                              source)
+        store.doc["comms"][key] = dict(
+            ident, collective=collective, program="shuffle/merge",
+            shape_bucket=bucket, source=source, calls=samples,
+            bytes=float(per_call) * samples,
+            latency_ms=float(mean_ms) * samples,
+            latency_samples=samples, runs=1)
+    return store
+
+
+# --- the chooser: every provenance path against doctored stores ----------
+
+
+def test_chooser_curve_selects_cheaper_collective():
+    # all_gather measured 3x cheaper at the exact bucket -> selected
+    store = _doctored_store([
+        ("all_to_all", BUCKET, PAYLOAD, 9.0, 5, "probe"),
+        ("all_gather", BUCKET, PAYLOAD, 3.0, 5, "probe"),
+    ])
+    d = choose_collective(store, IDENT, S, CAP, ROW_BYTES)
+    assert d["method"] == "all_gather"
+    assert d["provenance"] == "curve"
+    assert d["bucket"] == BUCKET
+    assert d["payload_bytes"] == PAYLOAD
+    ev = d["evidence"]
+    assert ev["all_gather"]["predicted_ms"] == pytest.approx(3.0)
+    assert ev["all_to_all"]["predicted_ms"] == pytest.approx(9.0)
+    assert ev["all_gather"]["by_source"] == {"probe": 5}
+    assert ev["all_gather"]["bucket_distance"] == 0
+    # the flipped comparison picks the monolith
+    d2 = choose_collective(_doctored_store([
+        ("all_to_all", BUCKET, PAYLOAD, 2.0, 5, "job"),
+        ("all_gather", BUCKET, PAYLOAD, 8.0, 5, "job"),
+    ]), IDENT, S, CAP, ROW_BYTES)
+    assert d2["method"] == "all_to_all"
+    assert d2["provenance"] == "curve"
+
+
+def test_chooser_cold_store_falls_back_with_named_reason():
+    for store in (None, calib.CalibStore()):
+        d = choose_collective(store, IDENT, S, CAP, ROW_BYTES)
+        assert d["method"] == EXCHANGE_COLLECTIVES[0]  # the default
+        assert d["provenance"] == "default"
+        assert "cold store" in d["reason"]
+        assert d["evidence"]["all_to_all"]["bucket_distance"] is None
+
+
+def test_chooser_wrong_identity_is_cold():
+    # same rows under a different mesh identity must not steer this one
+    store = _doctored_store([
+        ("all_to_all", BUCKET, PAYLOAD, 9.0, 5, "probe"),
+        ("all_gather", BUCKET, PAYLOAD, 3.0, 5, "probe"),
+    ], ident={"platform": "tpu", "device_count": 4, "topology": "1x4"})
+    d = choose_collective(store, IDENT, S, CAP, ROW_BYTES)
+    assert d["provenance"] == "default"
+    assert "cold store" in d["reason"]
+
+
+def test_chooser_out_of_range_is_extrapolation_not_evidence():
+    # curves sampled only at 4MB; the job lands at 64KB -> 6 pow2 steps
+    far = 4 << 20
+    store = _doctored_store([
+        ("all_to_all", "4MB", far, 9.0, 5, "probe"),
+        ("all_gather", "4MB", far, 3.0, 5, "probe"),
+    ])
+    d = choose_collective(store, IDENT, S, CAP, ROW_BYTES)
+    assert d["method"] == EXCHANGE_COLLECTIVES[0]
+    assert d["provenance"] == "default"
+    assert "out of bucket range" in d["reason"]
+    assert "extrapolation" in d["reason"]
+    assert d["evidence"]["all_to_all"]["bucket_distance"] == 6
+    # the coverage plane reports the same distance for the gauges
+    ev = calib.collective_evidence(store, IDENT, "all_gather", BUCKET)
+    assert ev["bucket_distance"] == 6
+    assert ev["samples"] == 0
+
+
+def test_chooser_min_samples_floor():
+    store = _doctored_store([
+        ("all_to_all", BUCKET, PAYLOAD, 9.0, 2, "probe"),
+        ("all_gather", BUCKET, PAYLOAD, 3.0, 2, "probe"),
+    ])
+    d = choose_collective(store, IDENT, S, CAP, ROW_BYTES)  # default floor 3
+    assert d["provenance"] == "default"
+    assert "below min-samples floor" in d["reason"]
+    # lowering the floor to the evidence level unlocks the curve
+    d2 = choose_collective(store, IDENT, S, CAP, ROW_BYTES, min_samples=2)
+    assert d2["provenance"] == "curve"
+    assert d2["method"] == "all_gather"
+
+
+def test_chooser_requires_evidence_for_both_methods():
+    # one strong curve is not enough: the comparison needs both
+    store = _doctored_store([
+        ("all_gather", BUCKET, PAYLOAD, 3.0, 5, "probe"),
+    ])
+    d = choose_collective(store, IDENT, S, CAP, ROW_BYTES)
+    assert d["provenance"] == "default"
+    assert d["method"] == EXCHANGE_COLLECTIVES[0]
+
+
+def test_chooser_pooled_sources_stay_attributable():
+    # probe + job rows pool for density but by_source keeps them split
+    store = _doctored_store([
+        ("all_to_all", BUCKET, PAYLOAD, 9.0, 2, "probe"),
+        ("all_to_all", BUCKET, PAYLOAD, 9.0, 2, "job"),
+        ("all_gather", BUCKET, PAYLOAD, 3.0, 4, "probe"),
+    ])
+    d = choose_collective(store, IDENT, S, CAP, ROW_BYTES)
+    assert d["provenance"] == "curve"
+    assert d["evidence"]["all_to_all"]["samples"] == 4
+    assert d["evidence"]["all_to_all"]["by_source"] == {
+        "probe": 2, "job": 2}
+
+
+def test_chooser_user_pin_short_circuits():
+    d = choose_collective(None, IDENT, S, CAP, ROW_BYTES,
+                          requested="all_gather")
+    assert d["method"] == "all_gather"
+    assert d["provenance"] == "pinned"
+
+
+# --- parity pins: the jax-free mirrors must track the source tuples ------
+
+
+def test_collective_name_mirrors_stay_in_sync():
+    # calib's jax-free mirror of the shuffle tuple
+    assert calib.EXCHANGE_COLLECTIVE_NAMES == EXCHANGE_COLLECTIVES
+    # config.validate's hardcoded literal (jax-free CLI path)
+    from map_oxidize_tpu.config import JobConfig
+
+    for name in ("auto", *EXCHANGE_COLLECTIVES):
+        JobConfig(input_path="x", exchange_collective=name).validate()
+    with pytest.raises(ValueError, match="exchange_collective"):
+        JobConfig(input_path="x",
+                  exchange_collective="ring_reduce").validate()
+
+
+def test_exchange_shape_matches_engine_derivation():
+    # fold engines: cap = min(bps, 2*ceil(bps/S)+16), int32 value rows
+    cap, row = calib.exchange_shape(8, 1 << 16)
+    bps = (1 << 16) // 8
+    assert row == 4
+    assert cap == min(bps, 2 * (-(-bps // 8)) + 16)
+    # collect engines keep the full per-shard batch, u64 row tax
+    cap_c, row_c = calib.exchange_shape(8, 1 << 16, collect=True)
+    assert (cap_c, row_c) == (bps, 8)
+
+
+# --- coverage plane ------------------------------------------------------
+
+
+def test_coverage_report_needs_vs_has():
+    store = _doctored_store([
+        ("all_to_all", BUCKET, PAYLOAD, 9.0, 5, "probe"),
+        ("all_gather", "4MB", 4 << 20, 3.0, 5, "probe"),
+    ])
+    cells = [{"collective": c, "bucket": BUCKET}
+             for c in EXCHANGE_COLLECTIVES]
+    rep = calib.coverage_report(store, IDENT, cells)
+    assert rep["schema"] == "moxt-calib-coverage-v1"
+    assert rep["needed"] == 2
+    assert rep["covered"] == 1  # all_gather only sampled 6 buckets away
+    assert rep["coverage_pct"] == pytest.approx(50.0)
+    assert rep["extrapolation_bucket_distance"] == 6
+    text = calib.render_coverage(rep)
+    assert "50.0%" in text
+
+
+def test_coverage_vacuous_is_fully_covered():
+    # a single-shard job needs no collective cells: 100%, never a gate
+    # flag (0.0 here would false-fire the coverage-drop gate)
+    rep = calib.coverage_report(calib.CalibStore(), IDENT, [])
+    assert rep["needed"] == 0
+    assert rep["coverage_pct"] == 100.0
+    assert rep["extrapolation_bucket_distance"] == 0
+
+
+def test_bucket_index_parses_labels():
+    assert calib.bucket_index("64KB") == 16
+    assert calib.bucket_index("1MB") == 20
+    assert calib.bucket_index("512B") == 9
+    assert calib.bucket_index("0B") is None
+    assert calib.bucket_index("weird") is None
+
+
+# --- store mechanics: source tagging, legacy keys, concurrent merge ------
+
+
+def test_legacy_six_part_keys_normalize_to_job_source(tmp_path):
+    path = tmp_path / calib.CALIB_FILE
+    legacy_key = "|".join(["cpu", "8", "1x8", "all_to_all",
+                           "shuffle/merge", "64KB"])
+    doc = {"schema": calib.CALIB_SCHEMA, "version": calib.CALIB_VERSION,
+           "comms": {legacy_key: {
+               "platform": "cpu", "device_count": 8, "topology": "1x8",
+               "collective": "all_to_all", "program": "shuffle/merge",
+               "shape_bucket": "64KB", "calls": 4, "bytes": 4.0 * PAYLOAD,
+               "latency_ms": 20.0, "latency_samples": 4, "runs": 1}},
+           "programs": {}, "runs": 1}
+    path.write_text(json.dumps(doc))
+    store = calib.CalibStore.load(str(path))
+    assert legacy_key + "|job" in store.doc["comms"]
+    assert legacy_key not in store.doc["comms"]
+    row = store.doc["comms"][legacy_key + "|job"]
+    assert row["source"] == "job"
+    # and the normalized row feeds the evidence plane as job evidence
+    ev = calib.collective_evidence(store, IDENT, "all_to_all", "64KB")
+    assert ev["by_source"] == {"job": 4}
+
+
+def test_accumulate_rejects_unknown_source():
+    store = calib.CalibStore()
+    with pytest.raises(ValueError, match="source"):
+        store.accumulate_run(IDENT, [{"collective": "psum",
+                                      "program": "shuffle/merge",
+                                      "count": 1, "bytes": 64.0}], None,
+                             source="vibes")
+
+
+def test_probe_and_job_rows_never_collide(tmp_path):
+    # same (collective, program, bucket) cell, different sources ->
+    # distinct store rows, both visible and attributable after reload
+    path = str(tmp_path / calib.CALIB_FILE)
+    comms = [{"collective": "all_to_all", "program": "shuffle/merge",
+              "count": 2, "bytes": 2.0 * PAYLOAD,
+              "latency_ms": {"count": 2, "mean": 5.0}}]
+    a = calib.CalibStore(path=path)
+    a.accumulate_run(IDENT, comms, None, source="probe")
+    a.save_merged()
+    b = calib.CalibStore(path=path)  # fresh accumulation object, same file
+    b.accumulate_run(IDENT, comms, None, source="job")
+    b.save_merged()
+    merged = calib.CalibStore.load(path)
+    sources = {r["source"] for r in merged.doc["comms"].values()}
+    assert sources == {"probe", "job"}
+    assert merged.doc["runs"] == 2
+    ev = calib.collective_evidence(merged, IDENT, "all_to_all", BUCKET)
+    assert ev["by_source"] == {"probe": 2, "job": 2}
+
+
+# --- the probe harness: real mesh programs, real rows --------------------
+
+
+def test_probe_round_trip_fills_a_selectable_curve(tmp_path):
+    from map_oxidize_tpu.obs.probe import render_probe, run_probe
+
+    summary = run_probe(str(tmp_path), buckets=("16KB", "32KB"), reps=3)
+    assert summary["schema"] == "moxt-calib-probe-v1"
+    assert summary["num_shards"] == 8
+    assert summary["rows_merged"] > 0
+    # both exchange wire programs, the psum reduction, and the top-k
+    # all_gather all probed
+    progs = {(c["collective"], c["program"]) for c in summary["cells"]}
+    for coll in EXCHANGE_COLLECTIVES:
+        assert (coll, "shuffle/merge") in progs
+    assert ("psum", "shuffle/merge") in progs
+    assert ("all_gather", "shuffle/top_k") in progs
+    render_probe(summary)  # renderer must hold on a real summary
+
+    store = calib.CalibStore.load(str(tmp_path))
+    assert store.doc["runs"] == 1
+    assert all(r["source"] == "probe"
+               for r in store.doc["comms"].values())
+    # one probe on a cold store is enough evidence for the chooser: pick
+    # a cap whose payload lands in a probed bucket
+    ident = calib.run_identity()
+    cell = next(c for c in summary["cells"]
+                if c["program"] == "shuffle/merge")
+    cap = cell["payload_bytes"] // (8 * 8 * (8 + 4))
+    d = choose_collective(store, ident, 8, cap, 4)
+    assert d["provenance"] == "curve", d["reason"]
+    assert d["method"] in EXCHANGE_COLLECTIVES
+    assert d["evidence"][d["method"]]["by_source"].get("probe", 0) >= 3
+    # and the coverage gauges read nonzero for the probed cells
+    rep = calib.coverage_report(
+        store, ident, [{"collective": c, "bucket": d["bucket"]}
+                       for c in EXCHANGE_COLLECTIVES])
+    assert rep["coverage_pct"] == 100.0
+    assert rep["extrapolation_bucket_distance"] == 0
+
+
+def test_probe_merges_concurrently_with_job_evidence(tmp_path):
+    # a job finishing mid-probe: save_merged's read-merge-write keeps
+    # both (the probe holds ONLY its own rows, so no double count)
+    from map_oxidize_tpu.obs.probe import run_probe
+
+    run_probe(str(tmp_path), buckets=("16KB",), reps=3)
+    job = calib.CalibStore(path=str(tmp_path / calib.CALIB_FILE))
+    job.accumulate_run(calib.run_identity(), [
+        {"collective": "all_to_all", "program": "shuffle/merge",
+         "count": 3, "bytes": 3.0 * 20000,
+         "latency_ms": {"count": 3, "mean": 4.0}}], None, source="job")
+    job.save_merged()
+    merged = calib.CalibStore.load(str(tmp_path))
+    assert merged.doc["runs"] == 2
+    by_source = {}
+    for r in merged.doc["comms"].values():
+        by_source[r["source"]] = by_source.get(r["source"], 0) + 1
+    assert by_source["probe"] >= 4 and by_source["job"] == 1
+
+
+# --- exchange-method parity: the chooser may never change results --------
+
+
+def test_all_gather_exchange_is_byte_identical(rng):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from map_oxidize_tpu.parallel.mesh import SHARD_AXIS, make_mesh
+    from map_oxidize_tpu.parallel.shuffle import _exchange
+    from map_oxidize_tpu.utils.jax_compat import shard_map
+
+    mesh = make_mesh(8)
+    cap = 16
+    n = 8 * 32  # 32 rows/shard -> mean 4 per bucket, far under cap
+    hi = rng.integers(0, 1 << 32, size=n, dtype=np.uint32)
+    lo = rng.integers(0, 1 << 32, size=n, dtype=np.uint32)
+    vals = np.ones(n, dtype=np.int32)
+    outs = {}
+    for method in EXCHANGE_COLLECTIVES:
+        def body(h, l, v, _m=method):
+            return _exchange(h, l, v, 8, cap, method=_m)
+
+        fn = jax.jit(shard_map(
+            body, mesh=mesh,
+            in_specs=(P(SHARD_AXIS),) * 3,
+            out_specs=(P(SHARD_AXIS),) * 3 + (P(),)))
+        r_hi, r_lo, r_vals, ovf = fn(hi, lo, vals)
+        assert int(np.asarray(ovf).reshape(-1)[0]) == 0
+        outs[method] = (np.asarray(r_hi), np.asarray(r_lo),
+                        np.asarray(r_vals))
+    a, b = outs[EXCHANGE_COLLECTIVES[0]], outs[EXCHANGE_COLLECTIVES[1]]
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+# --- 2-process Gloo probe: lockstep sweep, identical stores --------------
+
+_PROBE_CHILD = r"""
+import json, sys
+pid = int(sys.argv[1]); nproc = int(sys.argv[2]); port = sys.argv[3]
+store_dir = sys.argv[4]
+from map_oxidize_tpu.parallel.distributed import init_distributed
+init_distributed(f"127.0.0.1:{port}", num_processes=nproc, process_id=pid)
+from map_oxidize_tpu.obs.probe import run_probe
+s = run_probe(store_dir, buckets=("16KB", "64KB"), reps=2,
+              n_processes=nproc)
+print("probe child", pid, "merged", s["rows_merged"])
+"""
+
+
+@pytest.mark.slow
+def test_probe_two_process_gloo_identical_stores(tmp_path):
+    from tests.test_distributed import _env, _free_port
+
+    nproc = 2
+    dirs = [str(tmp_path / f"p{i}") for i in range(nproc)]
+    env = _env(devices=4)  # 2 procs x 4 local = 8-device global mesh
+    for attempt in range(2):
+        port = _free_port()
+        procs = [subprocess.Popen(
+            [sys.executable, "-c", _PROBE_CHILD, str(i), str(nproc),
+             str(port), dirs[i]],
+            env=env, cwd=REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True) for i in range(nproc)]
+        logs = []
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=420)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                out = "(timeout)"
+            logs.append(out)
+        if all(p.returncode == 0 for p in procs):
+            break
+        if attempt == 1:
+            for i, p in enumerate(procs):
+                assert p.returncode == 0, f"process {i} failed:\n{logs[i]}"
+    stores = [calib.CalibStore.load(d) for d in dirs]
+    keys = [sorted(s.doc["comms"]) for s in stores]
+    assert keys[0] == keys[1] and keys[0], logs
+    for key in keys[0]:
+        a, b = stores[0].doc["comms"][key], stores[1].doc["comms"][key]
+        # deterministic sweep: identical shapes/payloads/counts (walls
+        # differ — they are measurements)
+        for field in ("calls", "bytes", "latency_samples", "runs",
+                      "source", "collective", "program", "shape_bucket",
+                      "topology", "device_count"):
+            assert a[field] == b[field], (key, field)
+        assert a["source"] == "probe"
+    # the distributed identity rode in: 2-process topology, 8 devices
+    row = stores[0].doc["comms"][keys[0][0]]
+    assert row["topology"] == "2x8"
+    assert row["device_count"] == 8
